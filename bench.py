@@ -1,0 +1,109 @@
+"""Headline benchmark: VGG16 / CIFAR-10-shape training throughput on TPU.
+
+BASELINE.json metric: images/sec/chip (VGG16, CIFAR-10), north star >= 60% MFU.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+``vs_baseline`` is measured MFU / 0.60 (the north-star MFU target — the
+reference publishes no numbers of its own, BASELINE.md).
+
+Runs on whatever jax.devices() provides (one real TPU chip under the driver;
+CPU fallback works for smoke-testing with BENCH_STEPS/BENCH_BATCH overrides).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_training_pytorch_tpu.models import VGG16
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+
+# bf16 peak TFLOP/s per chip, by PJRT device_kind substring.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # v5e litepod chip (197 bf16 TFLOP/s)
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+    "cpu": 1e12,  # nominal, for smoke runs
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 1e12
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "32"))
+    num_classes = 10
+
+    mesh = mesh_lib.create_mesh()
+    model = VGG16(num_classes=num_classes, dtype=jnp.bfloat16)
+
+    def criterion(logits, b):
+        loss = cross_entropy_loss(logits, b["label"])
+        return loss, {"loss": loss, "accuracy": accuracy(logits, b["label"])}
+
+    engine = TrainEngine(
+        make_supervised_loss(model, criterion),
+        optax.sgd(0.01, momentum=0.9),
+        mesh,
+    )
+    state = engine.init_state(
+        jax.random.key(0),
+        lambda rng: model.init(rng, jnp.zeros((1, image_size, image_size, 3))),
+    )
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "image": rng.randn(batch, image_size, image_size, 3).astype(np.float32),
+        "label": rng.randint(0, num_classes, size=(batch,)).astype(np.int32),
+    }
+    gbatch = engine.shard_batch(host_batch)
+
+    # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
+    # it, and run that same executable in the timed loop — one compile total.
+    compiled = engine._train_step.lower(state, gbatch).compile()
+    cost = compiled.cost_analysis()
+    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+
+    # Warmup, then timed loop. Sync via a scalar device_get —
+    # block_until_ready alone can be a no-op on relay-backed platforms.
+    state, m = compiled(state, gbatch)
+    _ = float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, gbatch)
+    _ = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    images_per_sec = batch * steps / dt
+    flops_per_sec = step_flops * steps / dt
+    mfu = flops_per_sec / (peak_flops(jax.devices()[0]) * n_chips) if step_flops else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "images/sec/chip (VGG16, CIFAR-10-shape, bf16)",
+                "value": round(images_per_sec / n_chips, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(mfu / 0.60, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""distributed_training_pytorch_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``ducphuongbk01/Distributed-Training-Pytorch`` (reference: ``trainer/trainer.py``,
+``example_trainer.py``, ``model/vgg16.py``, ``dataset/example_dataset.py``,
+``utils/logger.py``, ``main.py``, ``eval.py``, ``run.sh``): a template-method
+trainer with user-overridable hooks, multi-host data-parallel training,
+epoch-based orchestration with periodic validation, best/last/periodic
+checkpointing with snapshot resume, file+console logging, and a standalone
+offline evaluator — rebuilt TPU-first:
+
+* ``parallel``  — device-mesh bootstrap (``jax.distributed`` + ``jax.sharding.Mesh``),
+  sharding rules, ring attention / sequence parallelism.
+* ``models``    — Flax model zoo (VGG16, ResNet-50, ViT-B/16, ConvNeXt-L).
+* ``ops``       — losses, metrics, schedules, Pallas kernels.
+* ``train``     — functional ``TrainState`` + jitted train/eval step engine
+  (replaces DDP + criterion/optimizer/scheduler mutation).
+* ``data``      — deterministic host-sharded input pipeline with device prefetch
+  (replaces ``DistributedSampler`` + ``DataLoader``).
+* ``checkpoint``— Orbax-backed best/last/periodic checkpointing with resume.
+* ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.
+* ``utils``     — logging, profiling, configuration.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
+    setup_distributed,
+    create_mesh,
+    shutdown_distributed,
+)

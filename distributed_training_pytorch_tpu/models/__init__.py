@@ -1,0 +1,15 @@
+from distributed_training_pytorch_tpu.models.vgg import VGG16, ConvBlock  # noqa: F401
+
+
+def create_model(name: str, num_classes: int, **kwargs):
+    """Model-zoo factory. Names match BASELINE.json configs."""
+    name = name.lower()
+    if name in ("vgg16", "vgg"):
+        return VGG16(num_classes=num_classes, **kwargs)
+    if name in ("resnet50", "resnet"):
+        raise NotImplementedError("resnet50 is not implemented yet")
+    if name in ("vit", "vit-b/16", "vit_b16", "vitb16"):
+        raise NotImplementedError("vit-b/16 is not implemented yet")
+    if name in ("convnext-l", "convnext_l", "convnextl", "convnext"):
+        raise NotImplementedError("convnext-l is not implemented yet")
+    raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,97 @@
+"""VGG16 in Flax — architecture parity with the reference ``model/vgg16.py``.
+
+Mirrors: 5 conv stages of (64, 128, 256, 512, 512) channels with (2, 2, 3, 3, 3)
+3x3 conv+ReLU layers each followed by 2x2 max-pool (``model/vgg16.py:5-17,24-28``),
+adaptive average pool to 7x7 (``:34``), classifier 512*7*7 -> 4096 -> 4096 ->
+num_classes with dropout 0.3 (``:37-43``), Kaiming-normal conv init and
+N(0, 0.01) linear init (``:49-57``).
+
+TPU-first differences (design, not behavior): NHWC layout (XLA:TPU's native conv
+layout), a ``dtype`` knob for bfloat16 activations with float32 params, and the
+adaptive pool expressed as two constant pooling matrices contracted with the
+feature map — exact PyTorch ``AdaptiveAvgPool2d`` semantics, but lowered to MXU
+matmuls instead of gather/scatter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# torch kaiming_normal_(relu): std = sqrt(2 / fan). VGG uses fan_out mode.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+dense_kernel_init = nn.initializers.normal(stddev=0.01)
+
+
+def _adaptive_pool_matrix(in_size: int, out_size: int) -> np.ndarray:
+    """Row-stochastic (out_size, in_size) matrix implementing torch's
+    AdaptiveAvgPool1d bin assignment: bin i averages input range
+    [floor(i*H/out), ceil((i+1)*H/out))."""
+    mat = np.zeros((out_size, in_size), dtype=np.float32)
+    for i in range(out_size):
+        start = (i * in_size) // out_size
+        end = -(-((i + 1) * in_size) // out_size)  # ceil division
+        mat[i, start:end] = 1.0 / (end - start)
+    return mat
+
+
+def adaptive_avg_pool_2d(x: jax.Array, output_size: tuple[int, int]) -> jax.Array:
+    """Exact ``nn.AdaptiveAvgPool2d`` for NHWC tensors, as two matmuls."""
+    _, h, w, _ = x.shape
+    oh, ow = output_size
+    if (h, w) == (oh, ow):
+        return x
+    ph = jnp.asarray(_adaptive_pool_matrix(h, oh), dtype=x.dtype)
+    pw = jnp.asarray(_adaptive_pool_matrix(w, ow), dtype=x.dtype)
+    x = jnp.einsum("oh,bhwc->bowc", ph, x)
+    x = jnp.einsum("pw,bowc->bopc", pw, x)
+    return x
+
+
+class ConvBlock(nn.Module):
+    """N x (3x3 conv + ReLU) then 2x2 max-pool — ``model/vgg16.py:5-17``."""
+
+    features: int
+    num_layers: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for _ in range(self.num_layers):
+            x = nn.Conv(
+                self.features,
+                (3, 3),
+                padding=1,
+                dtype=self.dtype,
+                kernel_init=conv_kernel_init,
+            )(x)
+            x = nn.relu(x)
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+class VGG16(nn.Module):
+    """VGG16 classifier. Input NHWC; any spatial size (adaptive pool to 7x7)."""
+
+    num_classes: int = 3
+    stage_features: Sequence[int] = (64, 128, 256, 512, 512)
+    stage_layers: Sequence[int] = (2, 2, 3, 3, 3)
+    dropout_rate: float = 0.3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype)
+        for feats, layers in zip(self.stage_features, self.stage_layers):
+            x = ConvBlock(feats, layers, dtype=self.dtype)(x)
+        x = adaptive_avg_pool_2d(x, (7, 7))
+        x = x.reshape(x.shape[0], -1)
+        for width in (4096, 4096):
+            x = nn.Dense(width, dtype=self.dtype, kernel_init=dense_kernel_init)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, kernel_init=dense_kernel_init)(x)
+        return x.astype(jnp.float32)

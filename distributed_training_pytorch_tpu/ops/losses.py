@@ -1,0 +1,43 @@
+"""Loss functions.
+
+Replaces the reference's criterion hook output (``example_trainer.py:55-58`` —
+a closure over ``F.cross_entropy`` on raw logits). Losses always accumulate in
+float32 even when activations are bfloat16, so bf16 training (BASELINE config 5)
+keeps a stable loss scale without GradScaler machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_with_integer_labels(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Per-example stable softmax CE from integer labels. Returns shape [B]."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        smooth = -log_probs.mean(axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean CE over the (global) batch — under ``jit`` with a data-sharded
+    batch this mean is computed collectively, so the reported loss is the
+    *global* loss, fixing the reference's local-only reporting
+    (``trainer/trainer.py:175-178``)."""
+    return softmax_cross_entropy_with_integer_labels(
+        logits, labels, label_smoothing=label_smoothing
+    ).mean()

@@ -1,0 +1,33 @@
+"""Evaluation metrics.
+
+Covers the reference's two metric computations: batch accuracy inside
+``validate_step`` (``example_trainer.py:92-102``) and offline top-k accuracy
+(``eval.py:69-72``, computed there via sklearn). Everything is a pure jnp
+function so it can live inside a jitted eval step and be globally reduced for
+free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 accuracy over the batch (scalar in [0, 1])."""
+    return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+    """Top-k accuracy: fraction of rows whose true label is among the k
+    highest-scoring classes. Equivalent to sklearn's ``top_k_accuracy_score``
+    used by the reference's offline evaluator (``eval.py:69-70``)."""
+    _, top_idx = jax.lax.top_k(logits, k)
+    hit = (top_idx == labels[..., None]).any(axis=-1)
+    return hit.mean()
+
+
+def correct_count(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Number of correct top-1 predictions (for exact dataset-level accuracy
+    when the last batch is padded)."""
+    return (jnp.argmax(logits, axis=-1) == labels).sum()

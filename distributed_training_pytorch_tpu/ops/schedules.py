@@ -1,0 +1,43 @@
+"""Learning-rate schedules.
+
+``multistep_lr`` reproduces the reference's ``MultiStepLR(milestones, gamma)``
+scheduler (``example_trainer.py:65-66``); schedules here are *per-step*
+functions (optax convention) while the reference steps per epoch
+(``trainer/trainer.py:159``), so constructors take ``steps_per_epoch`` and
+epoch-denominated milestones to preserve the epoch semantics exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import optax
+
+
+def multistep_lr(
+    base_lr: float,
+    milestones: Sequence[int],
+    gamma: float = 0.1,
+    steps_per_epoch: int = 1,
+) -> optax.Schedule:
+    """LR = base_lr * gamma^(number of milestones passed), milestones in epochs."""
+    boundaries = {int(m) * steps_per_epoch: gamma for m in milestones}
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def warmup_cosine_lr(
+    base_lr: float,
+    total_epochs: int,
+    steps_per_epoch: int,
+    warmup_epochs: int = 5,
+    end_lr: float = 0.0,
+) -> optax.Schedule:
+    """Linear warmup + cosine decay (the standard recipe for the ViT/ConvNeXt
+    configs in BASELINE.json; not present in the reference)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=base_lr,
+        warmup_steps=max(1, warmup_epochs * steps_per_epoch),
+        decay_steps=max(1, total_epochs * steps_per_epoch),
+        end_value=end_lr,
+    )

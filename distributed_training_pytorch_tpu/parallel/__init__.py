@@ -1,0 +1,12 @@
+from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
+    setup_distributed,
+    shutdown_distributed,
+    create_mesh,
+    batch_sharding,
+    replicated_sharding,
+    local_batch_size,
+    process_index,
+    process_count,
+    is_coordinator,
+    global_array_from_host_local,
+)

@@ -1,0 +1,195 @@
+"""Device-mesh bootstrap and sharding helpers.
+
+TPU-native replacement for the reference's process-group layer
+(``trainer/trainer.py:74-82`` ``ddp_setup``/``destroy_process`` and the
+torchrun env-var rendezvous in ``run.sh:9-14``): instead of
+``init_process_group("nccl")`` plus per-rank CUDA device binding, we run
+``jax.distributed.initialize`` (coordinator-based rendezvous over DCN) once per
+host and build a named :class:`jax.sharding.Mesh` over all global devices.
+Collectives then ride ICI/DCN via shardings — there is no NCCL-style tuning
+surface (``run.sh:1-8``) because XLA's latency-hiding scheduler owns that.
+
+Mesh axes used throughout the framework:
+
+* ``data``  — data parallelism (the reference's only axis, DDP at
+  ``trainer/trainer.py:52``).
+* ``fsdp``  — parameter sharding (ZeRO-3 analog), optional.
+* ``tensor``— tensor parallelism for wide layers, optional.
+* ``seq``   — sequence/context parallelism (ring attention), optional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in mesh order. `data` is outermost so that pure-DP
+# meshes are contiguous over ICI and cross-host traffic stays on the data axis.
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQ_AXIS = "seq"
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, TENSOR_AXIS)
+
+_initialized = False
+
+
+def setup_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize multi-host JAX if launched as part of a pod.
+
+    Analog of ``Trainer.ddp_setup`` (``trainer/trainer.py:74-77``) — but a
+    no-op on single-process launches (TPU pods discovered via TPU metadata, or
+    explicit coordinator env vars mirroring torchrun's MASTER_ADDR/RANK/
+    WORLD_SIZE contract from ``run.sh:12-13``).
+
+    Env vars honored (all optional): ``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``,
+    ``PROCESS_ID``.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("NUM_PROCESSES"):
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PROCESS_ID"):
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    # Single-process (including single-host TPU and CPU tests): nothing to do.
+
+
+def shutdown_distributed() -> None:
+    """Analog of ``destroy_process`` (``trainer/trainer.py:80-82``)."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    """This host's process index (analog of torchrun RANK for hosts)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — the only process that writes logs/metadata,
+    mirroring the reference's rank-0-only sections (``trainer/trainer.py:115,163``)."""
+    return jax.process_index() == 0
+
+
+def create_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes`` maps axis name -> size; at most one size may be ``-1`` meaning
+    "all remaining devices". Default is a 1-D data mesh over every global
+    device — the TPU equivalent of the reference's flat DDP world
+    (``trainer/trainer.py:48-52``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {DATA_AXIS: -1})
+    n = len(devices)
+    known = 1
+    wildcard = None
+    for name, size in axes.items():
+        if size == -1:
+            if wildcard is not None:
+                raise ValueError("at most one mesh axis may be -1")
+            wildcard = name
+        else:
+            known *= size
+    if wildcard is not None:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        axes[wildcard] = n // known
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"mesh {axes} needs {total} devices, have {n}")
+    # Canonical ordering keeps `data` outermost regardless of dict order.
+    names = sorted(axes, key=lambda a: AXIS_ORDER.index(a) if a in AXIS_ORDER else 99)
+    shape = tuple(axes[name] for name in names)
+    device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(device_array, axis_names=tuple(names))
+
+
+def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] | None = None) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data-like mesh axes.
+
+    Replaces ``DistributedSampler``'s per-rank row assignment
+    (``trainer/trainer.py:215``) — the batch is one global ``jax.Array`` whose
+    leading axis is sharded over ``data`` (and ``fsdp`` if present).
+    """
+    if batch_axes is None:
+        batch_axes = [a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names]
+    spec = P(tuple(batch_axes)) if batch_axes else P()
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
+    """Per-host batch size — global-batch semantics of ``trainer/trainer.py:56``
+    (``batch_size // world_size``), except the divisor is host count because
+    each host feeds all of its local devices in one global array."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(f"global batch {global_batch_size} not divisible by {n} processes")
+    return global_batch_size // n
+
+
+def global_array_from_host_local(batch, mesh: Mesh) -> jax.Array:
+    """Assemble a global, data-sharded ``jax.Array`` from this host's slice.
+
+    The TPU analog of DDP's implicit "each rank holds its own batch rows":
+    every host passes its local rows; the result is a single global array laid
+    out across the mesh without any cross-host copy.
+    """
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh spec (used by the config system and ``run.sh`` twin)."""
+
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        axes = {DATA_AXIS: self.data}
+        if self.fsdp != 1:
+            axes[FSDP_AXIS] = self.fsdp
+        if self.seq != 1:
+            axes[SEQ_AXIS] = self.seq
+        if self.tensor != 1:
+            axes[TENSOR_AXIS] = self.tensor
+        return create_mesh(axes, devices=devices)

@@ -1,0 +1,5 @@
+from distributed_training_pytorch_tpu.train.state import TrainState  # noqa: F401
+from distributed_training_pytorch_tpu.train.engine import (  # noqa: F401
+    TrainEngine,
+    make_supervised_loss,
+)

@@ -1,0 +1,42 @@
+"""Functional training state.
+
+The reference keeps mutable training state spread across ``self.model``,
+``self.optimizer``, ``self.scheduler``, ``self.cur_epoch``
+(``trainer/trainer.py:38-45``). The TPU-native design threads one immutable
+pytree through a jitted step instead — XLA requires pure functions, and an
+explicit state pytree is also exactly what gets checkpointed (the analog of the
+snapshot dict at ``trainer/trainer.py:85-92``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """Everything that evolves during training, as one pytree.
+
+    * ``step``        — global optimizer step (scheduler position; the analog of
+      the ``epoch`` counter saved at ``trainer/trainer.py:87``).
+    * ``params``      — model parameters (``model.state_dict()`` analog).
+    * ``opt_state``   — optax state (optimizer + scheduler state analog; optax
+      schedules are functions of ``step`` so there is no separate scheduler
+      state to save, unlike ``scheduler.state_dict()`` at ``:91``).
+    * ``model_state`` — non-trainable collections (e.g. BatchNorm
+      ``batch_stats`` for ResNet); empty dict for stateless models.
+    * ``rng``         — PRNG key for dropout/augmentation; folded with ``step``
+      each call so resume is deterministic.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Any
+    rng: jax.Array
+
+    def variables(self) -> dict:
+        return {"params": self.params, **self.model_state}

@@ -1,0 +1,29 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+SURVEY.md §4: multi-device semantics are tested without a pod via
+``--xla_force_host_platform_device_count=8`` — real Mesh/jit/collective paths,
+no TPU required. The environment may pre-import jax with a TPU plugin
+registered (sitecustomize), so we both set the env vars AND flip
+``jax_platforms`` via config post-import; the CPU client reads XLA_FLAGS at
+its own first initialization, which has not happened yet.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {devs}"
+    return devs

@@ -1,0 +1,46 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+
+def test_default_mesh_is_1d_data(devices):
+    mesh = mesh_lib.create_mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == 8
+
+
+def test_mesh_wildcard_and_order(devices):
+    mesh = mesh_lib.create_mesh({"tensor": 2, "data": -1})
+    # canonical order keeps data outermost
+    assert mesh.axis_names == ("data", "tensor")
+    assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+
+
+def test_mesh_bad_sizes(devices):
+    with pytest.raises(ValueError):
+        mesh_lib.create_mesh({"data": 3})
+    with pytest.raises(ValueError):
+        mesh_lib.create_mesh({"data": -1, "tensor": -1})
+
+
+def test_batch_sharding_splits_leading_axis(devices):
+    mesh = mesh_lib.create_mesh()
+    batch = {"image": np.ones((16, 8, 8, 3), np.float32), "label": np.zeros((16,), np.int32)}
+    garr = mesh_lib.global_array_from_host_local(batch, mesh)
+    assert garr["image"].shape == (16, 8, 8, 3)
+    assert garr["image"].sharding.spec == P(("data",))
+    # each device holds 2 rows
+    assert garr["image"].addressable_shards[0].data.shape[0] == 2
+
+
+def test_local_batch_size_single_process(devices):
+    mesh = mesh_lib.create_mesh()
+    assert mesh_lib.local_batch_size(16, mesh) == 16  # one process holds all rows
+
+
+def test_mesh_config(devices):
+    mesh = mesh_lib.MeshConfig(data=-1, tensor=2).build()
+    assert mesh.shape == {"data": 4, "tensor": 2}

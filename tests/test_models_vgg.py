@@ -1,0 +1,56 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_pytorch_tpu.models import VGG16
+from distributed_training_pytorch_tpu.models.vgg import adaptive_avg_pool_2d
+
+
+def test_adaptive_pool_identity():
+    x = jnp.arange(2 * 7 * 7 * 3, dtype=jnp.float32).reshape(2, 7, 7, 3)
+    assert (adaptive_avg_pool_2d(x, (7, 7)) == x).all()
+
+
+def test_adaptive_pool_downsample_matches_torch_semantics():
+    # 4 -> 2: torch bins are [0:2], [2:4]
+    x = jnp.asarray(np.arange(4, dtype=np.float32)).reshape(1, 4, 1, 1)
+    out = adaptive_avg_pool_2d(x, (2, 1))
+    np.testing.assert_allclose(np.asarray(out).ravel(), [0.5, 2.5])
+
+
+def test_adaptive_pool_upsample_replicates():
+    # 1 -> 7: every output bin covers the single input pixel
+    x = jnp.full((1, 1, 1, 2), 3.0)
+    out = adaptive_avg_pool_2d(x, (7, 7))
+    assert out.shape == (1, 7, 7, 2)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_vgg16_forward_shapes_and_param_count():
+    model = VGG16(num_classes=3)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    # torchvision VGG16 with 3 classes: 134_285_128 params minus head diff.
+    # conv: 14_714_688; fc: 512*7*7*4096+4096 + 4096*4096+4096 + 4096*3+3
+    expected = 14_714_688 + (512 * 7 * 7 * 4096 + 4096) + (4096 * 4096 + 4096) + (4096 * 3 + 3)
+    assert n_params == expected
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)))
+    assert logits.shape == (2, 3)
+    assert logits.dtype == jnp.float32
+
+
+def test_vgg16_bf16_compute_f32_params():
+    model = VGG16(num_classes=3, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(variables["params"]))
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)))
+    assert logits.dtype == jnp.float32
+
+
+def test_vgg16_dropout_active_in_train_mode():
+    model = VGG16(num_classes=3, dropout_rate=0.5)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.ones((4, 32, 32, 3))
+    a = model.apply(variables, x, train=True, rngs={"dropout": jax.random.key(1)})
+    b = model.apply(variables, x, train=True, rngs={"dropout": jax.random.key(2)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))

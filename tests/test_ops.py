@@ -1,0 +1,59 @@
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import top_k_accuracy_score
+
+from distributed_training_pytorch_tpu.ops import (
+    cross_entropy_loss,
+    multistep_lr,
+    top_k_accuracy,
+    warmup_cosine_lr,
+)
+from distributed_training_pytorch_tpu.ops.losses import (
+    softmax_cross_entropy_with_integer_labels,
+)
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.asarray([[2.0, 0.0, -1.0], [0.0, 0.0, 0.0]])
+    labels = jnp.asarray([0, 2])
+    per_ex = softmax_cross_entropy_with_integer_labels(logits, labels)
+    expected0 = -np.log(np.exp(2.0) / (np.exp(2.0) + 1 + np.exp(-1.0)))
+    np.testing.assert_allclose(np.asarray(per_ex), [expected0, np.log(3.0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        float(cross_entropy_loss(logits, labels)), (expected0 + np.log(3.0)) / 2, rtol=1e-6
+    )
+
+
+def test_label_smoothing_increases_loss_on_confident_preds():
+    logits = jnp.asarray([[10.0, 0.0, 0.0]])
+    labels = jnp.asarray([0])
+    plain = float(cross_entropy_loss(logits, labels))
+    smoothed = float(cross_entropy_loss(logits, labels, label_smoothing=0.1))
+    assert smoothed > plain
+
+
+def test_top_k_accuracy_matches_sklearn():
+    rng = np.random.RandomState(0)
+    scores = rng.randn(64, 5)
+    labels = rng.randint(0, 5, size=64)
+    for k in (1, 2, 3):
+        ours = float(top_k_accuracy(jnp.asarray(scores), jnp.asarray(labels), k=k))
+        ref = top_k_accuracy_score(labels, scores, k=k, labels=np.arange(5))
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_multistep_lr_matches_reference_schedule():
+    # example_trainer.py:66 — MultiStepLR milestones [50,100,200], gamma 0.1
+    sched = multistep_lr(0.1, [50, 100, 200], 0.1, steps_per_epoch=10)
+    assert np.isclose(float(sched(0)), 0.1)
+    assert np.isclose(float(sched(499)), 0.1)
+    assert np.isclose(float(sched(500)), 0.01)
+    assert np.isclose(float(sched(1000)), 0.001)
+    assert np.isclose(float(sched(2000)), 1e-4)
+
+
+def test_warmup_cosine_endpoints():
+    sched = warmup_cosine_lr(1.0, total_epochs=10, steps_per_epoch=10, warmup_epochs=2)
+    assert float(sched(0)) < 1e-6
+    assert np.isclose(float(sched(20)), 1.0, atol=1e-3)
+    assert float(sched(100)) < 1e-3

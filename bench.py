@@ -74,7 +74,7 @@ def main():
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.
-    compiled = engine._train_step.lower(state, gbatch).compile()
+    compiled = engine.compile_train_step(state, gbatch)
     cost = compiled.cost_analysis()
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 

@@ -1,0 +1,6 @@
+from distributed_training_pytorch_tpu.checkpoint.manager import (  # noqa: F401
+    BEST,
+    LAST,
+    CheckpointManager,
+    epoch_checkpoint_name,
+)

@@ -1,0 +1,172 @@
+"""Named-checkpoint store with best / last / periodic policies + resume.
+
+Capability twin of the reference snapshot subsystem
+(``trainer/trainer.py:85-101`` ``_save_snapshot``/``_load_snapshot`` and the
+policy logic at ``:114-135,163-172``):
+
+* three named policies — ``best`` (on validation-metric improvement per a
+  ``(metric, "geq"|"leq")`` rule, ``trainer/trainer.py:118-124``), ``last``
+  (every validating epoch, ``:164-165``) and ``checkpoint_epoch_N`` (every
+  ``save_period`` epochs otherwise, ``:166-167``);
+* the snapshot payload {epoch, model, optimizer, scheduler state}
+  (``:85-92``) becomes {TrainState pytree, meta json} — optax schedules are
+  pure functions of ``state.step`` so there is no separate scheduler state;
+* resume restores ``cur_epoch`` so the epoch loop continues mid-schedule
+  (``:96-101``, ``:110``).
+
+TPU-native differences: saving is a *collective* (every process calls
+``save``; Orbax coordinates the single metadata write) so the reference's
+rank-0 + barrier choreography (``trainer/trainer.py:163-172``) disappears, and
+saves may run async so the step loop is not blocked on filesystem I/O.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import jax
+import orbax.checkpoint as ocp
+
+BEST = "best"
+LAST = "last"
+
+
+def epoch_checkpoint_name(epoch: int) -> str:
+    """``checkpoint_epoch_{N}`` — the periodic-save name at ``trainer/trainer.py:166``."""
+    return f"checkpoint_epoch_{epoch}"
+
+
+class CheckpointManager:
+    """Save/restore named checkpoints of a ``TrainState`` under ``directory``.
+
+    ``save_best_for=(metric_name, mode)`` with mode ``"geq"`` or ``"leq"``
+    mirrors the reference's best-fitness rule (``trainer/trainer.py:118-124``,
+    configured ``("accuracy", "geq")`` at ``main.py:18``): ``geq`` saves when
+    the new value is >= the best seen, ``leq`` when <=.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        save_best_for: tuple[str, str] | None = None,
+        async_save: bool = True,
+    ):
+        self.directory = os.path.abspath(os.fspath(directory))
+        if jax.process_index() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+        if save_best_for is not None:
+            metric, mode = save_best_for
+            if mode not in ("geq", "leq"):
+                raise ValueError(f"save_best_for mode must be 'geq' or 'leq', got {mode!r}")
+        self.save_best_for = save_best_for
+        self._best_value: float | None = None
+        handler = ocp.CompositeCheckpointHandler()
+        self._ckptr = (
+            ocp.AsyncCheckpointer(handler) if async_save else ocp.Checkpointer(handler)
+        )
+
+    # -- paths -------------------------------------------------------------
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def exists(self, name: str) -> bool:
+        # A checkpoint is complete once Orbax's commit marker logic has
+        # finalized the directory; an in-flight async save is not yet visible.
+        return os.path.isdir(self.path(name))
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, name: str, state: Any, epoch: int, metrics: Mapping | None = None) -> None:
+        """Collective save of ``state`` + meta under ``directory/name``.
+
+        ``epoch`` is stored as the *resume* epoch — the caller passes the next
+        epoch to train, matching the reference storing ``epoch + 1`` for
+        ``last`` and ``epoch`` for ``best`` (``trainer/trainer.py:87,124,165``
+        — the asymmetry is the caller's policy, not the store's).
+        """
+        self.wait()  # a name may be overwritten; finish any in-flight save first
+        meta = {"epoch": int(epoch), "best_value": self._best_value}
+        if metrics is not None:
+            meta["metrics"] = {k: float(v) for k, v in metrics.items()}
+        self._ckptr.save(
+            self.path(name),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+            force=True,
+        )
+
+    def maybe_save_best(self, metrics: Mapping, state: Any, epoch: int) -> bool:
+        """Apply the best-fitness rule; save under ``best`` on improvement.
+
+        Returns True when a new best was saved (``trainer/trainer.py:118-130``).
+        """
+        if self.save_best_for is None:
+            return False
+        metric, mode = self.save_best_for
+        if metric not in metrics:
+            raise KeyError(
+                f"save_best_for metric {metric!r} not in validation metrics {list(metrics)}"
+            )
+        value = float(metrics[metric])
+        improved = (
+            self._best_value is None
+            or (mode == "geq" and value >= self._best_value)
+            or (mode == "leq" and value <= self._best_value)
+        )
+        if improved:
+            self._best_value = value
+            self.save(BEST, state, epoch, metrics=metrics)
+        return improved
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, name_or_path: str, target_state: Any) -> tuple[Any, int]:
+        """Restore ``(state, resume_epoch)`` from a named checkpoint or path.
+
+        ``target_state`` is a concrete or abstract ``TrainState`` whose
+        structure/shardings define the restore layout — the analog of calling
+        ``_load_snapshot`` after ``build_model`` so keys line up
+        (``trainer/trainer.py:44-45,96-101``).
+        """
+        self.wait()  # an in-flight async save only becomes visible once committed
+        path = self.path(name_or_path) if os.sep not in name_or_path else name_or_path
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target_state)
+        restored = self._ckptr.restore(
+            path,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        meta = restored.meta or {}
+        if meta.get("best_value") is not None:
+            self._best_value = float(meta["best_value"])
+        return restored.state, int(meta.get("epoch", 0))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def best_value(self) -> float | None:
+        return self._best_value
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed."""
+        if isinstance(self._ckptr, ocp.AsyncCheckpointer):
+            self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._ckptr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

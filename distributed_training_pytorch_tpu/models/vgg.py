@@ -74,7 +74,8 @@ class ConvBlock(nn.Module):
 
 
 class VGG16(nn.Module):
-    """VGG16 classifier. Input NHWC; any spatial size (adaptive pool to 7x7)."""
+    """VGG16 classifier. Input NHWC, spatial dims >= 32x32 (five 2x2 max-pools;
+    the adaptive pool then maps any remaining size to 7x7)."""
 
     num_classes: int = 3
     stage_features: Sequence[int] = (64, 128, 256, 512, 512)
@@ -84,6 +85,12 @@ class VGG16(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        min_size = 2 ** len(self.stage_features)
+        if x.shape[1] < min_size or x.shape[2] < min_size:
+            raise ValueError(
+                f"VGG16 input spatial dims must be >= {min_size}x{min_size} "
+                f"({len(self.stage_features)} 2x2 max-pools), got {x.shape[1]}x{x.shape[2]}"
+            )
         x = x.astype(self.dtype)
         for feats, layers in zip(self.stage_features, self.stage_layers):
             x = ConvBlock(feats, layers, dtype=self.dtype)(x)

@@ -70,6 +70,12 @@ def setup_distributed(
             process_id=process_id,
         )
         _initialized = True
+    elif process_id is not None:
+        raise ValueError(
+            "PROCESS_ID is set but COORDINATOR_ADDRESS/NUM_PROCESSES are not — "
+            "a partial distributed config would silently train N independent "
+            "single-process worlds. Set all three (or none for single-process)."
+        )
     # Single-process (including single-host TPU and CPU tests): nothing to do.
 
 

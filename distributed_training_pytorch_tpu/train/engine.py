@@ -204,3 +204,10 @@ class TrainEngine:
         """Host-local rows -> one global data-sharded array (see
         ``parallel.mesh.global_array_from_host_local``)."""
         return mesh_lib.global_array_from_host_local(batch, self.mesh)
+
+    def compile_train_step(self, state: TrainState, batch):
+        """AOT-compile the train step for these shapes and return the compiled
+        executable (callable as ``compiled(state, batch)``). Supported surface
+        for benchmarking: ``compiled.cost_analysis()`` exposes XLA's FLOP
+        estimate for MFU math."""
+        return self._train_step.lower(state, batch).compile()

@@ -1,0 +1,1 @@
+from distributed_training_pytorch_tpu.utils.logger import Logger  # noqa: F401

@@ -1,0 +1,82 @@
+"""Console + file logger.
+
+Capability twin of the reference ``utils/logger.py:6-33`` (named stdlib logger,
+INFO level, timestamped format, console + file handlers, and a
+``log(message, log_type)`` method mapping warning/error/else -> level), with
+two deliberate multi-host fixes (SURVEY.md §2e):
+
+* the reference deletes and re-opens the *same* log file from every rank
+  (``utils/logger.py:11-12`` + ``main.py:5``) — a race on shared filesystems.
+  Here only process 0 attaches the file handler; other processes keep console
+  output prefixed with their process index.
+* file truncation happens via mode ``"w"`` on the handler instead of an
+  explicit ``os.remove`` (same observable behavior: a fresh file per run).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+import jax
+
+_FORMAT = "%(asctime)s | %(name)s | %(levelname)s | %(message)s"
+
+
+class Logger:
+    """``Logger(name, log_file)`` — the construction signature of the
+    reference (``utils/logger.py:6``: name + log path)."""
+
+    def __init__(
+        self,
+        name: str,
+        log_file: str | None = None,
+        *,
+        level: int = logging.INFO,
+        all_processes_to_file: bool = False,
+    ):
+        self.name = name
+        self.log_file = log_file
+        self._logger = logging.getLogger(f"{name}.{os.getpid()}")
+        self._logger.setLevel(level)
+        self._logger.propagate = False
+        self._logger.handlers.clear()
+
+        fmt = _FORMAT
+        if jax.process_count() > 1:
+            fmt = f"%(asctime)s | p{jax.process_index()} | %(name)s | %(levelname)s | %(message)s"
+        formatter = logging.Formatter(fmt)
+        console = logging.StreamHandler(sys.stdout)
+        console.setFormatter(formatter)
+        self._logger.addHandler(console)
+
+        if log_file is not None and (all_processes_to_file or jax.process_index() == 0):
+            if all_processes_to_file and jax.process_count() > 1:
+                root, ext = os.path.splitext(log_file)
+                log_file = f"{root}.p{jax.process_index()}{ext}"
+            os.makedirs(os.path.dirname(os.path.abspath(log_file)), exist_ok=True)
+            file_handler = logging.FileHandler(log_file, mode="w")
+            file_handler.setFormatter(formatter)
+            self._logger.addHandler(file_handler)
+            self.log_file = log_file
+
+    def log(self, message: str, log_type: str = "info") -> None:
+        """warning/error -> those levels, anything else -> info
+        (``utils/logger.py:27-33``)."""
+        if log_type == "warning":
+            self._logger.warning(message)
+        elif log_type == "error":
+            self._logger.error(message)
+        else:
+            self._logger.info(message)
+
+    # Convenience aliases so the Logger is drop-in usable as a stdlib-ish logger.
+    def info(self, message: str) -> None:
+        self._logger.info(message)
+
+    def warning(self, message: str) -> None:
+        self._logger.warning(message)
+
+    def error(self, message: str) -> None:
+        self._logger.error(message)

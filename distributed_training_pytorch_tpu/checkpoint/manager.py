@@ -91,10 +91,19 @@ class CheckpointManager:
         meta = {"epoch": int(epoch), "best_value": self._best_value}
         if metrics is not None:
             meta["metrics"] = {k: float(v) for k, v in metrics.items()}
+        # Decomposed layout (params / opt_state / rest) — the analog of the
+        # reference saving model/optimizer/scheduler state dicts as separate
+        # keys (``trainer/trainer.py:85-92``); it also lets consumers that
+        # only need weights (offline eval) restore params alone even when
+        # their optimizer differs from the training one.
         self._ckptr.save(
             self.path(name),
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
+                params=ocp.args.StandardSave(state.params),
+                opt_state=ocp.args.StandardSave(state.opt_state),
+                rest=ocp.args.StandardSave(
+                    {"step": state.step, "rng": state.rng, "model_state": state.model_state}
+                ),
                 meta=ocp.args.JsonSave(meta),
             ),
             force=True,
@@ -125,30 +134,58 @@ class CheckpointManager:
 
     # -- restore -----------------------------------------------------------
 
-    def restore(self, name_or_path: str, target_state: Any) -> tuple[Any, int]:
+    def restore(
+        self, name_or_path: str, target_state: Any, *, params_only: bool = False
+    ) -> tuple[Any, int]:
         """Restore ``(state, resume_epoch)`` from a named checkpoint or path.
 
         ``target_state`` is a concrete or abstract ``TrainState`` whose
         structure/shardings define the restore layout — the analog of calling
         ``_load_snapshot`` after ``build_model`` so keys line up
         (``trainer/trainer.py:44-45,96-101``).
+
+        ``params_only=True`` restores weights and model_state but keeps the
+        target's optimizer state/step — for consumers (offline eval,
+        fine-tuning) whose optimizer differs from the training run's.
         """
         self.wait()  # an in-flight async save only becomes visible once committed
         path = self.path(name_or_path) if os.sep not in name_or_path else name_or_path
         if not os.path.isdir(path):
             raise FileNotFoundError(f"no checkpoint at {path}")
+        if os.path.isdir(os.path.join(path, "state")):
+            raise ValueError(
+                f"{path} uses the pre-0.1 monolithic 'state' checkpoint layout; "
+                "re-save it with this version (decomposed params/opt_state/rest)."
+            )
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target_state)
-        restored = self._ckptr.restore(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
+        items = {
+            "params": ocp.args.StandardRestore(abstract.params),
+            "rest": ocp.args.StandardRestore(
+                {
+                    "step": abstract.step,
+                    "rng": abstract.rng,
+                    "model_state": abstract.model_state,
+                }
             ),
-        )
+            "meta": ocp.args.JsonRestore(),
+        }
+        if not params_only:
+            items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
+        restored = self._ckptr.restore(path, args=ocp.args.Composite(**items))
         meta = restored.meta or {}
         if meta.get("best_value") is not None:
             self._best_value = float(meta["best_value"])
-        return restored.state, int(meta.get("epoch", 0))
+        state = target_state.replace(
+            params=restored.params,
+            model_state=restored.rest["model_state"],
+        )
+        if not params_only:
+            state = state.replace(
+                opt_state=restored.opt_state,
+                step=restored.rest["step"],
+                rng=restored.rest["rng"],
+            )
+        return state, int(meta.get("epoch", 0))
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,12 @@
+from distributed_training_pytorch_tpu.data.dataset import (  # noqa: F401
+    ArrayDataSource,
+    ImageFolderDataSource,
+)
+from distributed_training_pytorch_tpu.data.loader import ShardedLoader  # noqa: F401
+from distributed_training_pytorch_tpu.data.prefetch import device_prefetch  # noqa: F401
+from distributed_training_pytorch_tpu.data.transforms import (  # noqa: F401
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    eval_transform,
+    train_transform,
+)

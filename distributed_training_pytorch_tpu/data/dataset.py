@@ -1,0 +1,89 @@
+"""Data sources: indexable record stores the loader shards across hosts.
+
+Capability twin of the reference ``dataset/example_dataset.py``: an
+image-folder dataset that scans ``<root>/<label>/`` directories into
+``(path, label_index)`` records (``dataset/example_dataset.py:24-30``) and
+decodes images BGR->RGB via cv2 (``:57-60``).
+
+Deliberate fix (SURVEY.md §2e): the reference shuffles the record list with an
+*unseeded* ``random.shuffle`` in the constructor (``:17``), giving every rank
+a different order under a sampler that assumes identical order. Here the scan
+order is deterministic (sorted) and all shuffling happens in the loader,
+seeded identically on every host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+class ImageFolderDataSource:
+    """Records = sorted files under ``<data_path>/<label>/`` per label.
+
+    ``labels`` maps directory name -> class index by position, the contract of
+    ``dataset/example_dataset.py:12,26-28`` (``labels.index(label)``).
+    """
+
+    def __init__(self, data_path: str, labels: Sequence[str], transform=None):
+        self.data_path = data_path
+        self.labels = list(labels)
+        # Applied by the loader (not __getitem__) so augmentation can be keyed
+        # by (epoch, record index) for determinism — see loader.ShardedLoader.
+        self.transform = transform
+        self.records: list[tuple[str, int]] = []
+        for idx, label in enumerate(self.labels):
+            label_dir = os.path.join(data_path, label)
+            if not os.path.isdir(label_dir):
+                raise FileNotFoundError(f"label directory missing: {label_dir}")
+            for fname in sorted(os.listdir(label_dir)):
+                if fname.lower().endswith(_IMAGE_EXTS):
+                    self.records.append((os.path.join(label_dir, fname), idx))
+        if not self.records:
+            raise ValueError(f"no images found under {data_path} for labels {labels}")
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __getitem__(self, index: int) -> dict:
+        path, label = self.records[index]
+        return {"image": _decode_image(path), "label": np.int32(label)}
+
+
+def _decode_image(path: str) -> np.ndarray:
+    """Decode to RGB uint8 HWC. cv2 reads BGR; flip to RGB — the exact
+    behavior of ``dataset/example_dataset.py:57-60``. Falls back to PIL."""
+    try:
+        import cv2
+
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError(f"cv2 failed to decode {path}")
+        return img[:, :, ::-1]  # BGR -> RGB
+    except ImportError:
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+
+
+class ArrayDataSource:
+    """In-memory source over parallel arrays — the synthetic-data path used by
+    tests and benchmarks (SURVEY.md §7 'minimum end-to-end slice')."""
+
+    def __init__(self, transform=None, **arrays: np.ndarray):
+        self.transform = transform
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"array lengths differ: {lengths}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self._len = next(iter(lengths.values()))
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, index: int) -> dict:
+        return {k: v[index] for k, v in self.arrays.items()}

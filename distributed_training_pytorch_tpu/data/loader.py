@@ -1,0 +1,162 @@
+"""Host-sharded batch loader with deterministic global shuffle.
+
+Capability twin of ``DataLoader`` + ``DistributedSampler``
+(``trainer/trainer.py:209-217``): global-batch semantics (the user specifies
+the *global* batch size, split across hosts — ``trainer/trainer.py:56``),
+per-epoch reshuffle via ``set_epoch`` (``:140``), and parallel host-side
+loading (``num_workers``, ``:213``).
+
+TPU-first differences:
+
+* the shuffle permutation is seeded by ``(seed, epoch)`` and computed
+  identically on every host (fixes the reference's cross-rank shuffle bug,
+  SURVEY.md §2e) — host ``p`` takes rows ``[p*L, (p+1)*L)`` of each global
+  batch, ``L = global_batch // process_count``;
+* batches have **static shape**: training drops the trailing partial batch
+  (XLA recompiles on shape change); eval pads the final batch and emits a
+  ``"mask"`` weight column so padded rows don't pollute metrics;
+* workers are threads, not processes — cv2/numpy release the GIL, and thread
+  workers share the page cache with zero pickling overhead.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from distributed_training_pytorch_tpu.data import transforms
+
+
+class ShardedLoader:
+    """Iterate host-local batches ``{field: np.ndarray}`` over a data source.
+
+    ``transform(image, epoch=, index=)`` is applied to the ``"image"`` field of
+    each record when provided (a :class:`~.transforms.Compose`).
+    """
+
+    def __init__(
+        self,
+        source,
+        global_batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        transform: Optional[Callable] = None,
+        num_workers: int = 8,
+        drop_last: bool = True,
+        pad_final: bool = False,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        if drop_last and pad_final:
+            raise ValueError("drop_last and pad_final are mutually exclusive")
+        self.source = source
+        self.global_batch_size = int(global_batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.transform = transform
+        self.num_workers = int(num_workers)
+        self.drop_last = drop_last
+        self.pad_final = pad_final
+        self._epoch = 0
+        self._pidx = jax.process_index() if process_index is None else process_index
+        self._pcount = jax.process_count() if process_count is None else process_count
+        if self.global_batch_size % self._pcount:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self._pcount} processes"
+            )
+        self.local_batch_size = self.global_batch_size // self._pcount
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the epoch permutation — ``sampler.set_epoch`` analog
+        (``trainer/trainer.py:140``)."""
+        self._epoch = int(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.source)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def _global_order(self) -> np.ndarray:
+        n = len(self.source)
+        if self.shuffle:
+            rng = np.random.Generator(
+                np.random.Philox(key=transforms.philox_key(self.seed, self._epoch, 0))
+            )
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def _load_one(self, index: int, epoch: int) -> dict:
+        record = dict(self.source[int(index)])
+        if self.transform is not None and "image" in record:
+            record["image"] = self.transform(record["image"], epoch=epoch, index=int(index))
+        return record
+
+    def _collate(self, records: list[dict], pad_to: int | None) -> dict:
+        batch = {
+            k: np.stack([r[k] for r in records]) for k in records[0]
+        }
+        n = len(records)
+        if pad_to is not None and n < pad_to:
+            pad = pad_to - n
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)]) for k, v in batch.items()
+            }
+            batch["mask"] = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+            )
+        elif self.pad_final:
+            batch["mask"] = np.ones(n, np.float32)
+        return batch
+
+    def __iter__(self) -> Iterator[dict]:
+        order = self._global_order()
+        epoch = self._epoch
+        n = len(order)
+        num_batches = len(self)
+        L = self.local_batch_size
+
+        def batch_indices(b: int) -> np.ndarray:
+            start = b * self.global_batch_size
+            rows = order[start : start + self.global_batch_size]
+            if len(rows) == self.global_batch_size:
+                return rows[self._pidx * L : (self._pidx + 1) * L]
+            # Final partial batch (pad_final mode): split what exists evenly.
+            local = -(-len(rows) // self._pcount)
+            return rows[self._pidx * local : (self._pidx + 1) * local]
+
+        if self.num_workers <= 0:
+            for b in range(num_batches):
+                rows = batch_indices(b)
+                records = [self._load_one(i, epoch) for i in rows]
+                yield self._collate(records, L if self.pad_final else None)
+            return
+
+        # Thread pool with a bounded in-flight window so decode/augment of
+        # batch b+1..b+2 overlaps consumption of batch b.
+        with cf.ThreadPoolExecutor(self.num_workers) as pool:
+            window: queue.Queue = queue.Queue()
+            ahead = 2
+
+            def submit(b: int):
+                rows = batch_indices(b)
+                futs = [pool.submit(self._load_one, i, epoch) for i in rows]
+                window.put(futs)
+
+            upto = min(ahead, num_batches)
+            for b in range(upto):
+                submit(b)
+            for b in range(num_batches):
+                futs = window.get()
+                records = [f.result() for f in futs]
+                if upto < num_batches:
+                    submit(upto)
+                    upto += 1
+                yield self._collate(records, L if self.pad_final else None)

@@ -1,0 +1,52 @@
+"""Device prefetch: overlap host batch production with device compute.
+
+The reference's per-step H2D copy is synchronous inside ``train_step``
+(``example_trainer.py:70,75`` — and never overlapped despite ``pin_memory``,
+SURVEY.md §2e). Here transfers are issued from a background thread ``depth``
+batches ahead: ``jax.make_array_from_process_local_data`` starts the async
+H2D copy and XLA's scheduler overlaps it with the running step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+import jax
+
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+
+def device_prefetch(
+    batches: Iterable[dict],
+    mesh: jax.sharding.Mesh,
+    *,
+    depth: int = 2,
+) -> Iterator[dict]:
+    """Yield global data-sharded ``jax.Array`` batches, ``depth`` in flight."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    err: list[BaseException] = []
+
+    def producer():
+        try:
+            for host_batch in batches:
+                q.put(mesh_lib.global_array_from_host_local(host_batch, mesh))
+        except BaseException as e:  # propagate into the consumer
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    thread = threading.Thread(target=producer, daemon=True, name="device-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        thread.join(timeout=1.0)

@@ -1,0 +1,192 @@
+"""Host-side image transforms with deterministic per-record randomness.
+
+Capability twin of the reference augmentation pipeline
+(``dataset/example_dataset.py:35-50``): train phase = Resize, RandomRotate90,
+HorizontalFlip, VerticalFlip, Blur, MedianBlur, CLAHE,
+RandomBrightnessContrast, RandomGamma, ImageCompression (each p=0.5),
+ImageNet-mean Normalize; val phase = Resize + Normalize only.
+
+Design differences (TPU-first, SURVEY.md §2e/§7):
+
+* randomness is a counter-based ``np.random.Philox`` keyed by
+  ``(seed, epoch, record_index)`` — every host computes identical augmentation
+  for the same record, and resume replays the same epoch stream (the
+  reference's augmentations are unseeded process-global RNG);
+* output is float32 **HWC** (batched to NHWC, XLA:TPU's native conv layout)
+  rather than ToTensorV2's CHW (``:45``);
+* augmentation runs in loader worker threads on the host — TPU never sees it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+# A Transform maps (rgb uint8 HWC image, np.random.Generator) -> image.
+Transform = Callable[[np.ndarray, np.random.Generator], np.ndarray]
+
+
+def philox_key(seed: int, epoch: int, index: int) -> np.ndarray:
+    """Pack (seed, epoch, index) into Philox's 2x64-bit key (epoch in the top
+    24 bits of word 1, index below — supports 2^40 records per epoch)."""
+    word1 = (np.uint64(epoch) << np.uint64(40)) | np.uint64(index)
+    return np.array([np.uint64(seed), word1], dtype=np.uint64)
+
+
+def _cv2():
+    import cv2
+
+    return cv2
+
+
+def resize(height: int, width: int) -> Transform:
+    def apply(img, rng):
+        cv2 = _cv2()
+        return cv2.resize(img, (width, height), interpolation=cv2.INTER_LINEAR)
+
+    return apply
+
+
+def random_rotate90(p: float = 0.5) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            img = np.rot90(img, k=int(rng.integers(1, 4)))
+        return img
+
+    return apply
+
+
+def horizontal_flip(p: float = 0.5) -> Transform:
+    def apply(img, rng):
+        return img[:, ::-1] if rng.random() < p else img
+
+    return apply
+
+
+def vertical_flip(p: float = 0.5) -> Transform:
+    def apply(img, rng):
+        return img[::-1] if rng.random() < p else img
+
+    return apply
+
+
+def blur(p: float = 0.5, max_kernel: int = 7) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            k = int(rng.integers(1, max_kernel // 2 + 1)) * 2 + 1  # odd, 3..7
+            img = _cv2().blur(np.ascontiguousarray(img), (k, k))
+        return img
+
+    return apply
+
+
+def median_blur(p: float = 0.5, max_kernel: int = 5) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            k = int(rng.integers(1, max_kernel // 2 + 1)) * 2 + 1  # odd, 3..5
+            img = _cv2().medianBlur(np.ascontiguousarray(img), k)
+        return img
+
+    return apply
+
+
+def clahe(p: float = 0.5, clip_limit: float = 4.0, tile: int = 8) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            cv2 = _cv2()
+            lab = cv2.cvtColor(np.ascontiguousarray(img), cv2.COLOR_RGB2LAB)
+            op = cv2.createCLAHE(clipLimit=clip_limit, tileGridSize=(tile, tile))
+            lab[:, :, 0] = op.apply(lab[:, :, 0])
+            img = cv2.cvtColor(lab, cv2.COLOR_LAB2RGB)
+        return img
+
+    return apply
+
+
+def random_brightness_contrast(p: float = 0.5, limit: float = 0.2) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            alpha = 1.0 + float(rng.uniform(-limit, limit))  # contrast
+            beta = float(rng.uniform(-limit, limit)) * 255.0  # brightness
+            img = np.clip(img.astype(np.float32) * alpha + beta, 0, 255).astype(np.uint8)
+        return img
+
+    return apply
+
+
+def random_gamma(p: float = 0.5, gamma_range: tuple[int, int] = (80, 120)) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            gamma = float(rng.uniform(*gamma_range)) / 100.0
+            img = (np.power(img.astype(np.float32) / 255.0, gamma) * 255.0).astype(np.uint8)
+        return img
+
+    return apply
+
+
+def image_compression(p: float = 0.5, quality_range: tuple[int, int] = (80, 100)) -> Transform:
+    def apply(img, rng):
+        if rng.random() < p:
+            cv2 = _cv2()
+            quality = int(rng.integers(quality_range[0], quality_range[1] + 1))
+            ok, enc = cv2.imencode(
+                ".jpg",
+                np.ascontiguousarray(img[:, :, ::-1]),
+                [int(cv2.IMWRITE_JPEG_QUALITY), quality],
+            )
+            if ok:
+                img = cv2.imdecode(enc, cv2.IMREAD_COLOR)[:, :, ::-1]
+        return img
+
+    return apply
+
+
+def normalize(mean: np.ndarray = IMAGENET_MEAN, std: np.ndarray = IMAGENET_STD) -> Transform:
+    def apply(img, rng):
+        return (img.astype(np.float32) / 255.0 - mean) / std
+
+    return apply
+
+
+class Compose:
+    """Apply transforms in order with a Philox generator keyed by
+    ``(seed, epoch, index)`` — deterministic and host-independent."""
+
+    def __init__(self, transforms: Sequence[Transform], seed: int = 0):
+        self.transforms = list(transforms)
+        self.seed = seed
+
+    def __call__(self, img: np.ndarray, *, epoch: int = 0, index: int = 0) -> np.ndarray:
+        rng = np.random.Generator(np.random.Philox(key=philox_key(self.seed, epoch, index)))
+        for t in self.transforms:
+            img = t(img, rng)
+        return np.ascontiguousarray(img)
+
+
+def train_transform(height: int, width: int, *, seed: int = 0, p: float = 0.5) -> Compose:
+    """The train-phase pipeline of ``dataset/example_dataset.py:35-46``."""
+    return Compose(
+        [
+            resize(height, width),
+            random_rotate90(p),
+            horizontal_flip(p),
+            vertical_flip(p),
+            blur(p),
+            median_blur(p),
+            clahe(p),
+            random_brightness_contrast(p),
+            random_gamma(p),
+            image_compression(p),
+            normalize(),
+        ],
+        seed=seed,
+    )
+
+
+def eval_transform(height: int, width: int) -> Compose:
+    """The val-phase pipeline of ``dataset/example_dataset.py:48-50``."""
+    return Compose([resize(height, width), normalize()])

@@ -33,11 +33,28 @@ def cross_entropy_loss(
     labels: jax.Array,
     *,
     label_smoothing: float = 0.0,
+    weights: jax.Array | None = None,
 ) -> jax.Array:
     """Mean CE over the (global) batch — under ``jit`` with a data-sharded
     batch this mean is computed collectively, so the reported loss is the
     *global* loss, fixing the reference's local-only reporting
-    (``trainer/trainer.py:175-178``)."""
-    return softmax_cross_entropy_with_integer_labels(
+    (``trainer/trainer.py:175-178``).
+
+    ``weights`` (shape [B], e.g. the loader's pad ``mask``) turns the mean into
+    a weighted mean so padded rows contribute nothing."""
+    nll = softmax_cross_entropy_with_integer_labels(
         logits, labels, label_smoothing=label_smoothing
-    ).mean()
+    )
+    return weighted_mean(nll, weights)
+
+
+def weighted_mean(values: jax.Array, weights: jax.Array | None = None) -> jax.Array:
+    """Mean of per-example values, optionally weighted (pad-mask aware).
+    An all-zero weight vector yields 0, not NaN; fractional weights divide by
+    their true sum."""
+    values = values.astype(jnp.float32)
+    if weights is None:
+        return values.mean()
+    weights = weights.astype(jnp.float32)
+    total = weights.sum()
+    return jnp.where(total > 0, (values * weights).sum() / jnp.maximum(total, 1e-8), 0.0)

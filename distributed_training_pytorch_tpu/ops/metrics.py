@@ -12,19 +12,26 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-
-def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Top-1 accuracy over the batch (scalar in [0, 1])."""
-    return (jnp.argmax(logits, axis=-1) == labels).mean()
+from distributed_training_pytorch_tpu.ops.losses import weighted_mean
 
 
-def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+def accuracy(
+    logits: jax.Array, labels: jax.Array, weights: jax.Array | None = None
+) -> jax.Array:
+    """Top-1 accuracy over the batch (scalar in [0, 1]). ``weights`` (e.g. the
+    loader's pad ``mask``) makes it a weighted mean over real rows only."""
+    return weighted_mean(jnp.argmax(logits, axis=-1) == labels, weights)
+
+
+def top_k_accuracy(
+    logits: jax.Array, labels: jax.Array, k: int = 1, weights: jax.Array | None = None
+) -> jax.Array:
     """Top-k accuracy: fraction of rows whose true label is among the k
     highest-scoring classes. Equivalent to sklearn's ``top_k_accuracy_score``
     used by the reference's offline evaluator (``eval.py:69-70``)."""
     _, top_idx = jax.lax.top_k(logits, k)
     hit = (top_idx == labels[..., None]).any(axis=-1)
-    return hit.mean()
+    return weighted_mean(hit, weights)
 
 
 def correct_count(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -33,11 +33,14 @@ def warmup_cosine_lr(
     end_lr: float = 0.0,
 ) -> optax.Schedule:
     """Linear warmup + cosine decay (the standard recipe for the ViT/ConvNeXt
-    configs in BASELINE.json; not present in the reference)."""
+    configs in BASELINE.json; not present in the reference). Warmup is clamped
+    below the run length so degenerate short runs still get a cosine phase."""
+    total_steps = max(2, total_epochs * steps_per_epoch)
+    warmup_steps = max(1, min(warmup_epochs * steps_per_epoch, total_steps - 1))
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=base_lr,
-        warmup_steps=max(1, warmup_epochs * steps_per_epoch),
-        decay_steps=max(1, total_epochs * steps_per_epoch),
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
         end_value=end_lr,
     )

@@ -1,0 +1,1 @@
+from distributed_training_pytorch_tpu.trainer.trainer import Trainer  # noqa: F401

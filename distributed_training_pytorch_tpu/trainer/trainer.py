@@ -1,0 +1,350 @@
+"""The epoch-loop orchestrator — the framework's user-facing core.
+
+Capability twin of the reference ``trainer/trainer.py`` abstract ``Trainer``:
+the same template-method surface (the nine user hooks, ``trainer/trainer.py:
+219-253``), the same constructor contract (``:15-24``), the same epoch loop —
+resume-aware range (``:110``), periodic validation with best-model tracking
+(``:114-135``), per-epoch train loop with progress bar and loss collection
+(``:138-156``), scheduler reporting (``:159-160``), last/periodic
+checkpointing (``:163-172``), mean-loss logging (``:175-178``) — rebuilt on a
+functional core:
+
+* mutable ``self.model/optimizer/scheduler`` become one :class:`TrainState`
+  pytree threaded through a jitted step (``train.engine.TrainEngine``);
+* DDP + NCCL barriers disappear: the batch is sharded over the mesh's ``data``
+  axis, XLA inserts and overlaps the gradient all-reduce, and checkpoint saves
+  are collective (Orbax), so there is no rank-0 barrier choreography;
+* validation is *collective* (every device evaluates a shard) instead of the
+  reference's rank-0-only full-dataset pass (``:184-206``, SURVEY.md §2e), and
+  reported metrics are global means, not per-rank locals;
+* the scheduler is an optax per-step schedule fused into the optimizer, so
+  "scheduler state" is just ``state.step``.
+
+Hook mapping (reference -> here):
+
+=================  ==========================================================
+``build_train_dataset``  same name; returns an indexable source (may carry a
+                         ``.transform`` applied by the loader)
+``build_val_dataset``    same (fixed to default to *val* data, §2e bug)
+``build_model``          same; returns a Flax module
+``build_criterion``      same; returns ``(outputs, batch) -> (loss, metrics)``
+``build_optimizer``      same; receives the schedule, returns an optax
+                         ``GradientTransformation``
+``build_scheduler``      same; returns an optax per-step ``Schedule`` or a
+                         constant lr
+``preprocess_batch``     same; host-side, before device transfer (the H2D copy
+                         itself is the framework's job now)
+``train_step``           same name; ``(state, batch) -> (state, metrics)`` —
+                         default delegates to the compiled engine step
+``validate_step``        same name; ``(state, batch) -> metrics`` — default is
+                         the compiled collective eval step
+=================  ==========================================================
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_training_pytorch_tpu.checkpoint import (
+    BEST,
+    LAST,
+    CheckpointManager,
+    epoch_checkpoint_name,
+)
+from distributed_training_pytorch_tpu.data import ShardedLoader, device_prefetch
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+
+
+class Trainer:
+    """Subclass, implement the hooks, call :meth:`train`.
+
+    Constructor args mirror ``trainer/trainer.py:15-24``; ``pin_memory`` is
+    accepted for source compatibility but ignored (device transfer is async
+    via the prefetcher — there is no pageable/pinned distinction to manage).
+    """
+
+    def __init__(
+        self,
+        max_epoch: int,
+        batch_size: int,
+        pin_memory: bool = False,
+        have_validate: bool = False,
+        save_best_for: tuple[str, str] | None = None,
+        save_period: int | None = None,
+        save_folder: str = ".",
+        snapshot_path: str | None = None,
+        logger=None,
+        *,
+        mesh: jax.sharding.Mesh | None = None,
+        seed: int = 0,
+        accum_steps: int = 1,
+        num_workers: int = 8,
+        log_every: int = 50,
+        async_checkpoint: bool = True,
+    ):
+        # Logger closure — exact contract of ``trainer/trainer.py:26``.
+        self.log = (
+            (lambda msg, log_type="info": logger.log(msg, log_type))
+            if logger is not None
+            else (lambda msg, log_type="info": print(f"{log_type.upper()}: {msg}"))
+        )
+
+        self.max_epoch = max_epoch
+        self.batch_size = batch_size
+        self.have_validate = have_validate
+        self.save_best_for = save_best_for
+        self.save_period = save_period
+        self.seed = seed
+        self.accum_steps = accum_steps
+        self.num_workers = num_workers
+        self.log_every = log_every
+        self.cur_epoch = 0
+
+        # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
+        self.save_folder = save_folder
+        self.save_weight_folder = os.path.join(save_folder, "weights")
+        self.checkpoints = CheckpointManager(
+            self.save_weight_folder,
+            save_best_for=save_best_for,
+            async_save=async_checkpoint,
+        )
+
+        # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE
+        # env reads + DDP wrap, ``:48-52``).
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+        self.world_size = self.mesh.devices.size
+        self.local_batch_size = batch_size // jax.process_count()
+
+        # Build hooks (``:38-41``) — model/criterion/schedule/optimizer.
+        self.model = self.build_model()
+        self.criterion = self.build_criterion()
+        schedule = self.build_scheduler()
+        if schedule is None:
+            schedule = optax.constant_schedule(0.0)
+        elif not callable(schedule):
+            schedule = optax.constant_schedule(float(schedule))
+        self.schedule = schedule
+        self.optimizer = self.build_optimizer(self.schedule)
+
+        self.engine = TrainEngine(
+            make_supervised_loss(self.model, self.criterion),
+            self.optimizer,
+            self.mesh,
+            accum_steps=accum_steps,
+            schedule=self.schedule,
+        )
+
+        # Datasets + loaders (``:56-71``). Train first: example-input inference
+        # for lazy Flax init may read the train source.
+        self.train_dataset = self.build_train_dataset()
+        self.train_dataloader = self.build_dataloader(self.train_dataset, phase="train")
+        self.val_dataloader = None
+        if have_validate:
+            self.val_dataset = self.build_val_dataset()
+            self.val_dataloader = self.build_dataloader(self.val_dataset, phase="val")
+
+        # State init (replaces model.to(device) + DDP param broadcast).
+        example = self.build_example_input()
+        self.state = self.engine.init_state(
+            jax.random.key(seed),
+            lambda rng: self.model.init(rng, example),
+        )
+
+        # Snapshot resume (``:44-45,96-101``).
+        if snapshot_path is not None:
+            self.state, self.cur_epoch = self.checkpoints.restore(snapshot_path, self.state)
+            self.log(f"Resumed from {snapshot_path} at epoch {self.cur_epoch}")
+
+    # ------------------------------------------------------------------
+    # Framework-provided machinery (overridable, like ``build_dataloader``
+    # at ``trainer/trainer.py:209-217``).
+    # ------------------------------------------------------------------
+
+    def build_dataloader(self, dataset, phase: str = "train") -> ShardedLoader:
+        """Default loader: deterministic global shuffle for train (fixing the
+        reference's cross-rank shuffle bug, SURVEY.md §2e), padded static-shape
+        final batch for val."""
+        train = phase == "train"
+        return ShardedLoader(
+            dataset,
+            self.batch_size,
+            shuffle=train,
+            seed=self.seed,
+            transform=getattr(dataset, "transform", None),
+            num_workers=self.num_workers,
+            drop_last=train,
+            pad_final=not train,
+        )
+
+    def build_example_input(self) -> jax.Array:
+        """A zero batch for Flax shape inference, derived from the first train
+        record. Override for models whose input is not ``record['image']``."""
+        record = self.train_dataset[0]
+        image = record["image"]
+        if self.train_dataloader.transform is not None:
+            image = self.train_dataloader.transform(image, epoch=0, index=0)
+        return jnp.zeros((1,) + tuple(np.shape(image)), jnp.float32)
+
+    # ------------------------------------------------------------------
+    # Train / validate loops
+    # ------------------------------------------------------------------
+
+    def train(self) -> None:
+        """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
+        best_banner: dict | None = None
+        for epoch in range(self.cur_epoch, self.max_epoch):
+            self.cur_epoch = epoch
+
+            # Periodic validation + best-model tracking at the top of the
+            # epoch (``:114-135`` — validates *before* this epoch's training;
+            # best stores label `epoch`, deliberate parity with §2e).
+            if self.have_validate and self.save_period and epoch % self.save_period == 0:
+                metrics = self.validate()
+                if self.checkpoints.maybe_save_best(metrics, self.state, epoch):
+                    best_banner = {"epoch": epoch, "metrics": dict(metrics)}
+                if best_banner is not None:
+                    self.log(100 * "=")
+                    msg = f"The BEST model is at EPOCH {best_banner['epoch']} and has "
+                    for k, v in best_banner["metrics"].items():
+                        msg += f" | {k.upper()} = {v} | "
+                    self.log(msg)
+
+            # Train one epoch (``:138-156``).
+            self.train_dataloader.set_epoch(epoch)
+            self.log(100 * "=")
+            self.log(
+                f"[process {jax.process_index()}] Epoch {epoch + 1}/{self.max_epoch}"
+            )
+            epoch_metrics = self.train_epoch(epoch)
+
+            # Next-LR report (``:159-160``) — optax schedules are per-step.
+            next_lr = float(self.schedule(self.state.step))
+            self.log(f"THE NEXT LEARNING RATE VALUE IS {next_lr}")
+
+            # last / periodic checkpoint (``:163-172``): saved epoch is
+            # epoch+1 = the next epoch to train on resume (``:165-167``).
+            if self.have_validate:
+                self.checkpoints.save(LAST, self.state, epoch + 1)
+                self.log(f"Saved model at epoch {epoch + 1}!")
+            elif self.save_period and epoch % self.save_period == 0:
+                self.checkpoints.save(
+                    epoch_checkpoint_name(epoch + 1), self.state, epoch + 1
+                )
+                self.log(f"Saved model at epoch {epoch + 1}!")
+
+            # Epoch loss report — *global* means (pmean'd inside the step),
+            # upgrading the reference's local-only report (``:175-178``).
+            msg = "TOTAL GLOBAL TRAINING LOSS: "
+            for k, v in epoch_metrics.items():
+                msg += f" | {k} = {v} | "
+            self.log(msg)
+
+        self.checkpoints.wait()
+        self.log("Finished!")
+
+    def train_epoch(self, epoch: int) -> dict:
+        """Inner hot loop: compiled step per global batch, device-resident
+        metrics (no per-step host sync — the reference pays a ``loss.item()``
+        sync every step, ``example_trainer.py:89``)."""
+        collected: list[Any] = []
+        step_in_epoch = 0
+        t0 = time.perf_counter()
+        batches = device_prefetch(
+            (self.preprocess_batch(b) for b in self.train_dataloader), self.mesh
+        )
+        for batch in batches:
+            self.state, metrics = self.train_step(self.state, batch)
+            collected.append(metrics)
+            step_in_epoch += 1
+            if self.log_every and step_in_epoch % self.log_every == 0:
+                # The only intra-epoch host sync, every log_every steps.
+                m = {k: float(v) for k, v in collected[-1].items()}
+                rate = step_in_epoch * self.batch_size / (time.perf_counter() - t0)
+                self.log(
+                    f"  step {step_in_epoch}/{len(self.train_dataloader)} "
+                    f"{m} ({rate:.1f} img/s)"
+                )
+        if not collected:
+            return {}
+        host = jax.device_get(collected)
+        return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+
+    def validate(self) -> dict:
+        """Collective validation over the val loader; returns weighted-mean
+        metrics (pad-mask aware). Twin of ``trainer/trainer.py:184-206``."""
+        sums: dict[str, float] = {}
+        weight_total = 0.0
+        for host_batch in self.val_dataloader:
+            host_batch = self.preprocess_batch(host_batch)
+            if isinstance(host_batch, dict) and "mask" in host_batch:
+                weight = float(np.sum(host_batch["mask"]))
+            else:
+                weight = float(len(next(iter(host_batch.values()))))
+            batch = self.engine.shard_batch(host_batch)
+            metrics = self.validate_step(self.state, batch)
+            metrics = {k: float(v) for k, v in metrics.items()}
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + v * weight
+            weight_total += weight
+        avg = {k: v / max(weight_total, 1.0) for k, v in sums.items()}
+        msg = "VALIDATE RESULTS: "
+        for k, v in avg.items():
+            msg += f" | {k} = {v} | "
+        self.log(msg)
+        return avg
+
+    # ------------------------------------------------------------------
+    # The nine hooks (``trainer/trainer.py:219-253``) — same names.
+    # ------------------------------------------------------------------
+
+    def build_train_dataset(self):
+        raise NotImplementedError("Please implement the build_train_dataset method")
+
+    def build_val_dataset(self):
+        raise NotImplementedError("Please implement the build_val_dataset method")
+
+    def build_model(self):
+        raise NotImplementedError("Please implement the build_model method")
+
+    def build_criterion(self):
+        raise NotImplementedError("Please implement the build_criterion method")
+
+    def build_optimizer(self, schedule: optax.Schedule):
+        raise NotImplementedError("Please implement the build_optimizer method")
+
+    def build_scheduler(self):
+        raise NotImplementedError("Please implement the build_scheduler method")
+
+    def preprocess_batch(self, batch: Mapping) -> Mapping:
+        """Host-side batch hook. The reference uses this for the H2D copy
+        (``example_trainer.py:68-70``); here transfer is the framework's job,
+        so the default is identity."""
+        return batch
+
+    def train_step(self, state, batch):
+        """Default: the engine's compiled grad/reduce/update step."""
+        return self.engine.train_step(state, batch)
+
+    def validate_step(self, state, batch):
+        """Default: the engine's compiled collective eval step."""
+        return self.engine.eval_step(state, batch)
+
+    # ------------------------------------------------------------------
+    # Lifecycle statics — ``ddp_setup``/``destroy_process`` twins (``:74-82``).
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def distributed_setup(**kwargs) -> None:
+        mesh_lib.setup_distributed(**kwargs)
+
+    @staticmethod
+    def destroy_process() -> None:
+        mesh_lib.shutdown_distributed()

@@ -1,0 +1,93 @@
+"""Concrete example trainer — capability twin of ``example_trainer.py``.
+
+Binds the framework to VGG16 image-folder classification: implements all nine
+hooks with the reference's hyperparameters (VGG16, ``example_trainer.py:51-52``;
+cross-entropy criterion, ``:55-58``; SGD lr 0.1 momentum 0.9 wd 1e-4, ``:62``;
+MultiStepLR milestones [50, 100, 200] gamma 0.1, ``:66``; train/val
+augmentation, via the dataset transforms).
+
+Deliberate fix (SURVEY.md §2e): ``build_val_dataset`` reads ``val_path`` — the
+reference validates on its *training* data (``example_trainer.py:48``).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_training_pytorch_tpu.data import (
+    ImageFolderDataSource,
+    eval_transform,
+    train_transform,
+)
+from distributed_training_pytorch_tpu.models import VGG16
+from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, multistep_lr
+from distributed_training_pytorch_tpu.trainer import Trainer
+
+
+class ExampleTrainer(Trainer):
+    def __init__(
+        self,
+        train_path: str,
+        val_path: str,
+        labels: list[str],
+        height: int,
+        width: int,
+        **trainer_kwargs,
+    ):
+        self.train_path = train_path
+        self.val_path = val_path
+        self.labels = labels
+        self.height = height
+        self.width = width
+        super().__init__(**trainer_kwargs)
+
+    # -- data ---------------------------------------------------------------
+
+    def build_train_dataset(self):
+        return ImageFolderDataSource(
+            self.train_path,
+            self.labels,
+            transform=train_transform(self.height, self.width, seed=self.seed),
+        )
+
+    def build_val_dataset(self):
+        return ImageFolderDataSource(
+            self.val_path,
+            self.labels,
+            transform=eval_transform(self.height, self.width),
+        )
+
+    # -- model / objective ----------------------------------------------------
+
+    def build_model(self):
+        # VGG16(in_channels=3, out_channels=len(labels), init_weights=True)
+        # analog (``example_trainer.py:51-52``); Kaiming init is the model's
+        # default initializer.
+        return VGG16(num_classes=len(self.labels))
+
+    def build_criterion(self):
+        def criterion(logits, batch):
+            mask = batch.get("mask")
+            loss = cross_entropy_loss(logits, batch["label"], weights=mask)
+            return loss, {
+                "ce_loss": loss,
+                "accuracy": accuracy(logits, batch["label"], weights=mask),
+            }
+
+        return criterion
+
+    def build_optimizer(self, schedule):
+        # SGD lr=schedule momentum=0.9 weight_decay=1e-4 (``example_trainer.py:62``);
+        # decoupled ordering matches torch (wd added to grad before momentum).
+        return optax.chain(
+            optax.add_decayed_weights(1e-4),
+            optax.sgd(schedule, momentum=0.9),
+        )
+
+    def build_scheduler(self):
+        # MultiStepLR milestones [50, 100, 200] epochs, gamma 0.1
+        # (``example_trainer.py:66``) — converted to per-step boundaries.
+        steps_per_epoch = max(
+            1, len(ImageFolderDataSource(self.train_path, self.labels)) // self.batch_size
+        )
+        return multistep_lr(0.1, [50, 100, 200], gamma=0.1, steps_per_epoch=steps_per_epoch)

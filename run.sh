@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Launcher — capability twin of the reference ``run.sh`` (torchrun + NCCL env,
+# run.sh:1-14), rebuilt for TPU pods.
+#
+# On a single TPU host/slice this is just `./run.sh` — jax discovers every
+# local chip and shards over them (no NCCL_* tuning: XLA's latency-hiding
+# scheduler owns collective scheduling, SURVEY.md §2d).
+#
+# On a multi-host pod, run once per host with the coordinator env set —
+# the analog of torchrun's --master_addr/--node_rank contract (run.sh:9-14):
+#
+#   COORDINATOR_ADDRESS=<host0-ip>:1234 NUM_PROCESSES=<n-hosts> PROCESS_ID=<i> ./run.sh
+#
+# (On Cloud TPU pods these are auto-detected from TPU metadata; the vars are
+# only needed for manual rendezvous.)
+set -euo pipefail
+cd "$(dirname "$0")"
+
+# North-star config (BASELINE.md): VGG16 / CIFAR-10, bf16, DP over all chips.
+exec python examples/train_cifar10.py "$@"

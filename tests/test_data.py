@@ -1,0 +1,163 @@
+"""Input-pipeline tests: determinism, sharding semantics, transform parity
+with the reference op list (SURVEY.md §4, §7 step 4)."""
+
+import numpy as np
+import pytest
+
+from distributed_training_pytorch_tpu.data import (
+    ArrayDataSource,
+    ImageFolderDataSource,
+    ShardedLoader,
+    device_prefetch,
+    eval_transform,
+    train_transform,
+)
+from distributed_training_pytorch_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def image_root(tmp_path_factory):
+    """Tiny image-folder tree: 3 labels x 8 images (the reference layout,
+    dataset/example_dataset.py:24-30)."""
+    import cv2
+
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.RandomState(0)
+    for label in ("cat", "dog", "snake"):
+        d = root / label
+        d.mkdir()
+        for i in range(8):
+            img = rng.randint(0, 255, size=(40, 48, 3), dtype=np.uint8)
+            cv2.imwrite(str(d / f"{i}.png"), img)
+    return str(root)
+
+
+def test_image_folder_scan(image_root):
+    src = ImageFolderDataSource(image_root, ["cat", "dog", "snake"])
+    assert len(src) == 24
+    rec = src[0]
+    assert rec["image"].shape == (40, 48, 3)
+    assert rec["image"].dtype == np.uint8
+    labels = [src[i]["label"] for i in range(24)]
+    assert sorted(set(int(l) for l in labels)) == [0, 1, 2]
+    # Deterministic scan order: first 8 records are label 0 ("cat"), sorted.
+    assert all(int(l) == 0 for l in labels[:8])
+
+
+def test_image_folder_missing_label(image_root):
+    with pytest.raises(FileNotFoundError):
+        ImageFolderDataSource(image_root, ["cat", "bird"])
+
+
+def test_transforms_deterministic():
+    img = np.random.RandomState(1).randint(0, 255, size=(50, 50, 3), dtype=np.uint8)
+    t = train_transform(32, 32, seed=7)
+    a = t(img, epoch=3, index=11)
+    b = t(img, epoch=3, index=11)
+    np.testing.assert_array_equal(a, b)
+    c = t(img, epoch=4, index=11)
+    assert not np.array_equal(a, c), "different epoch must give different augmentation"
+    assert a.shape == (32, 32, 3) and a.dtype == np.float32
+
+
+def test_eval_transform_is_resize_normalize_only():
+    img = np.full((10, 10, 3), 128, np.uint8)
+    out = eval_transform(8, 8)(img)
+    expected = (128 / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-5)
+    # Deterministic regardless of epoch/index (no random ops).
+    np.testing.assert_array_equal(out, eval_transform(8, 8)(img, epoch=9, index=9))
+
+
+def test_loader_global_batch_semantics():
+    n = 40
+    src = ArrayDataSource(x=np.arange(n, dtype=np.int32), label=np.zeros(n, np.int32))
+    # Simulate 4 hosts: each must see a disjoint quarter of the same permutation.
+    loaders = [
+        ShardedLoader(
+            src, 8, shuffle=True, seed=3, num_workers=0, process_index=p, process_count=4
+        )
+        for p in range(4)
+    ]
+    for ld in loaders:
+        ld.set_epoch(2)
+        assert len(ld) == 5
+        assert ld.local_batch_size == 2
+    per_host = [list(ld) for ld in loaders]
+    for b in range(5):
+        rows = np.concatenate([per_host[p][b]["x"] for p in range(4)])
+        assert len(set(rows.tolist())) == 8, "hosts must cover disjoint rows"
+    all_rows = np.concatenate([per_host[p][b]["x"] for b in range(5) for p in range(4)])
+    assert sorted(all_rows.tolist()) == list(range(40)), "epoch covers each record once"
+
+
+def test_loader_epoch_reshuffle_and_resume_determinism():
+    src = ArrayDataSource(x=np.arange(16, dtype=np.int32))
+    ld = ShardedLoader(src, 4, shuffle=True, seed=0, num_workers=0)
+    ld.set_epoch(0)
+    e0 = np.concatenate([b["x"] for b in ld])
+    ld.set_epoch(1)
+    e1 = np.concatenate([b["x"] for b in ld])
+    assert not np.array_equal(e0, e1), "set_epoch must reshuffle"
+    ld2 = ShardedLoader(src, 4, shuffle=True, seed=0, num_workers=0)
+    ld2.set_epoch(1)
+    np.testing.assert_array_equal(e1, np.concatenate([b["x"] for b in ld2]))
+
+
+def test_loader_drop_last_vs_pad_final():
+    src = ArrayDataSource(x=np.arange(10, dtype=np.int32))
+    ld = ShardedLoader(src, 4, shuffle=False, num_workers=0)  # drop_last default
+    batches = list(ld)
+    assert len(batches) == 2 and all(len(b["x"]) == 4 for b in batches)
+
+    ld = ShardedLoader(src, 4, shuffle=False, num_workers=0, drop_last=False, pad_final=True)
+    batches = list(ld)
+    assert len(batches) == 3
+    assert batches[-1]["x"].shape == (4,), "final batch must be padded to static shape"
+    np.testing.assert_array_equal(batches[-1]["mask"], [1, 1, 0, 0])
+    np.testing.assert_array_equal(batches[0]["mask"], [1, 1, 1, 1])
+    # Padding repeats the last real row.
+    np.testing.assert_array_equal(batches[-1]["x"], [8, 9, 9, 9])
+
+
+def test_loader_threaded_matches_serial(image_root):
+    src = ImageFolderDataSource(image_root, ["cat", "dog", "snake"])
+    t = train_transform(24, 24, seed=5)
+    kw = dict(shuffle=True, seed=9, transform=t)
+    serial = list(ShardedLoader(src, 8, num_workers=0, **kw))
+    threaded = list(ShardedLoader(src, 8, num_workers=4, **kw))
+    assert len(serial) == len(threaded) == 3
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_device_prefetch(devices):
+    mesh = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+    src = ArrayDataSource(
+        image=np.random.RandomState(0).randn(32, 8, 8, 3).astype(np.float32),
+        label=np.arange(32, dtype=np.int32),
+    )
+    ld = ShardedLoader(src, 16, shuffle=False, num_workers=0)
+    out = list(device_prefetch(iter(ld), mesh))
+    assert len(out) == 2
+    import jax
+
+    assert isinstance(out[0]["image"], jax.Array)
+    assert out[0]["image"].shape == (16, 8, 8, 3)
+    assert out[0]["image"].sharding.spec == mesh_lib.batch_sharding(mesh).spec
+    np.testing.assert_array_equal(np.asarray(out[1]["label"]), np.arange(16, 32))
+
+
+def test_device_prefetch_propagates_errors(devices):
+    mesh = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+
+    def bad_iter():
+        yield {"x": np.zeros((8,), np.float32)}
+        raise RuntimeError("decode failed")
+
+    it = device_prefetch(bad_iter(), mesh)
+    next(it)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(it)

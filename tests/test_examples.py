@@ -1,0 +1,168 @@
+"""End-to-end tests of the example surfaces: ExampleTrainer (main.py twin),
+offline eval (eval.py twin), and the CIFAR-10 north-star entry — the
+example-as-smoke-test role the reference fills with main.py (SURVEY.md §4).
+
+Models are shrunk (tiny VGG stages) so the 8-virtual-device CPU compiles stay
+fast; the full-size path is covered by bench.py on real TPU.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_pytorch_tpu.models import VGG16
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    """train/val/test image-folder trees with 3 labels (reference layout)."""
+    import cv2
+
+    root = tmp_path_factory.mktemp("data")
+    rng = np.random.RandomState(0)
+    for split, n in (("train", 8), ("val", 4), ("test", 4)):
+        for li, label in enumerate(("cat", "dog", "snake")):
+            d = root / split / label
+            d.mkdir(parents=True)
+            for i in range(n):
+                img = rng.randint(0, 255, size=(48, 48, 3), dtype=np.uint8)
+                img[:, :, li % 3] = np.minimum(255, img[:, :, li % 3] + 80)  # separable
+                cv2.imwrite(str(d / f"{i}.png"), img)
+    return root
+
+
+@pytest.fixture
+def mesh(devices):
+    return mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+
+
+def tiny_vgg(num_classes):
+    return VGG16(num_classes=num_classes, stage_features=(4, 8), stage_layers=(1, 1))
+
+
+def make_example_trainer(data_root, mesh, tmp_path, **kw):
+    from examples.example_trainer import ExampleTrainer
+
+    class TinyExampleTrainer(ExampleTrainer):
+        def build_model(self):
+            return tiny_vgg(len(self.labels))
+
+    defaults = dict(
+        train_path=str(data_root / "train"),
+        val_path=str(data_root / "val"),
+        labels=["cat", "dog", "snake"],
+        height=32,
+        width=32,
+        max_epoch=2,
+        batch_size=8,
+        have_validate=True,
+        save_best_for=("accuracy", "geq"),
+        save_period=1,
+        save_folder=str(tmp_path / "runs"),
+        mesh=mesh,
+        num_workers=2,
+        log_every=0,
+        async_checkpoint=False,
+    )
+    defaults.update(kw)
+    return TinyExampleTrainer(**defaults)
+
+
+def test_example_trainer_end_to_end(data_root, mesh, tmp_path):
+    trainer = make_example_trainer(data_root, mesh, tmp_path)
+    trainer.train()
+    assert trainer.checkpoints.exists("best")
+    assert trainer.checkpoints.exists("last")
+    # val dataset reads val_path (the reference's train_path bug is fixed).
+    assert trainer.val_dataset.data_path == str(data_root / "val")
+    # Reference optimizer recipe: schedule starts at lr 0.1.
+    assert float(trainer.schedule(0)) == pytest.approx(0.1)
+
+
+def test_offline_eval(data_root, mesh, tmp_path):
+    from examples import eval as eval_mod
+
+    trainer = make_example_trainer(data_root, mesh, tmp_path, max_epoch=1, num_workers=0)
+    trainer.train()
+    results = eval_mod.evaluate(
+        str(tmp_path / "runs" / "weights" / "last"),
+        str(data_root / "test"),
+        batch=8,
+        model=tiny_vgg(3),
+        height=32,
+        width=32,
+        mesh=mesh,
+    )
+    assert set(results) == {"top1", "top2"}
+    assert 0.0 <= results["top1"] <= results["top2"] <= 1.0
+
+
+def test_cifar10_synthetic_fallback(tmp_path, mesh):
+    from examples.train_cifar10 import Cifar10Trainer, load_cifar10
+
+    x, y, tx, ty = load_cifar10(str(tmp_path / "missing"))
+    assert x.shape == (50000, 32, 32, 3) and x.dtype == np.uint8
+    assert tx.shape == (10000, 32, 32, 3)
+
+    class TinyCifar(Cifar10Trainer):
+        def build_model(self):
+            return tiny_vgg(10)
+
+    trainer = TinyCifar(
+        data_dir=str(tmp_path / "missing"),
+        base_lr=0.025,
+        max_epoch=1,
+        batch_size=64,
+        have_validate=False,
+        save_period=100,
+        save_folder=str(tmp_path / "runs"),
+        mesh=mesh,
+        num_workers=0,
+        log_every=0,
+        async_checkpoint=False,
+    )
+    # One short epoch on a subset: shrink the dataset for test speed.
+    trainer.train_x = trainer.train_x[:256]
+    trainer.train_y = trainer.train_y[:256]
+    trainer.train_dataset = trainer.build_train_dataset()
+    trainer.train_dataloader = trainer.build_dataloader(trainer.train_dataset, "train")
+    metrics = trainer.train_epoch(0)
+    assert np.isfinite(metrics["ce_loss"])
+
+
+def test_cifar10_pickle_reader(tmp_path):
+    """Write the canonical cifar-10-batches-py layout and read it back."""
+    import pickle
+
+    from examples.train_cifar10 import load_cifar10
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + [("test_batch", 10)]:
+        data = {
+            b"data": rng.randint(0, 255, size=(n, 3072), dtype=np.uint8),
+            b"labels": rng.randint(0, 10, size=(n,)).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(data, f)
+    x, y, tx, ty = load_cifar10(str(d))
+    assert x.shape == (100, 32, 32, 3) and tx.shape == (10, 32, 32, 3)
+    assert y.dtype == np.int32
+
+
+def test_cifar10_transform_determinism():
+    from examples.train_cifar10 import Cifar10Transform
+
+    img = np.random.RandomState(0).randint(0, 255, size=(32, 32, 3), dtype=np.uint8)
+    t = Cifar10Transform(seed=1, train=True)
+    np.testing.assert_array_equal(t(img, epoch=2, index=3), t(img, epoch=2, index=3))
+    assert not np.array_equal(t(img, epoch=2, index=3), t(img, epoch=3, index=3))
+    # Val transform is deterministic normalization only.
+    tv = Cifar10Transform(train=False)
+    np.testing.assert_array_equal(tv(img), tv(img, epoch=7, index=7))

@@ -99,44 +99,49 @@ class ShardedLoader:
             record["image"] = self.transform(record["image"], epoch=epoch, index=int(index))
         return record
 
-    def _collate(self, records: list[dict], pad_to: int | None) -> dict:
-        batch = {
-            k: np.stack([r[k] for r in records]) for k in records[0]
-        }
-        n = len(records)
-        if pad_to is not None and n < pad_to:
-            pad = pad_to - n
-            batch = {
-                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)]) for k, v in batch.items()
-            }
-            batch["mask"] = np.concatenate(
-                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
-            )
-        elif self.pad_final:
-            batch["mask"] = np.ones(n, np.float32)
+    def _collate(self, records: list[dict], mask: np.ndarray | None) -> dict:
+        batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
+        if mask is not None:
+            batch["mask"] = mask
         return batch
+
+    def global_real_count(self, batch_index: int) -> int:
+        """Real (unpadded) rows in global batch ``batch_index`` — identical on
+        every host; the correct cross-batch aggregation weight for padded
+        validation (each host's local mask sum differs, this does not)."""
+        n = len(self.source)
+        return max(0, min(self.global_batch_size, n - batch_index * self.global_batch_size))
 
     def __iter__(self) -> Iterator[dict]:
         order = self._global_order()
         epoch = self._epoch
-        n = len(order)
         num_batches = len(self)
+        G = self.global_batch_size
         L = self.local_batch_size
 
-        def batch_indices(b: int) -> np.ndarray:
-            start = b * self.global_batch_size
-            rows = order[start : start + self.global_batch_size]
-            if len(rows) == self.global_batch_size:
-                return rows[self._pidx * L : (self._pidx + 1) * L]
-            # Final partial batch (pad_final mode): split what exists evenly.
-            local = -(-len(rows) // self._pcount)
-            return rows[self._pidx * local : (self._pidx + 1) * local]
+        def batch_indices(b: int) -> tuple[np.ndarray, np.ndarray | None]:
+            """This host's row indices for global batch b, plus its slice of
+            the global pad mask (None when the loader doesn't pad).
+
+            The final partial batch is padded at the *global* level (repeat
+            the last real row up to G) and then sliced per host — every host
+            always produces exactly L rows, and the mask is globally
+            consistent regardless of how real rows land across hosts."""
+            rows = order[b * G : (b + 1) * G]
+            mask = None
+            if self.pad_final:
+                real = len(rows)
+                if real < G:
+                    rows = np.concatenate([rows, np.repeat(rows[-1:], G - real)])
+                mask = (np.arange(G) < real).astype(np.float32)
+                mask = mask[self._pidx * L : (self._pidx + 1) * L]
+            return rows[self._pidx * L : (self._pidx + 1) * L], mask
 
         if self.num_workers <= 0:
             for b in range(num_batches):
-                rows = batch_indices(b)
+                rows, mask = batch_indices(b)
                 records = [self._load_one(i, epoch) for i in rows]
-                yield self._collate(records, L if self.pad_final else None)
+                yield self._collate(records, mask)
             return
 
         # Thread pool with a bounded in-flight window so decode/augment of
@@ -146,17 +151,17 @@ class ShardedLoader:
             ahead = 2
 
             def submit(b: int):
-                rows = batch_indices(b)
+                rows, mask = batch_indices(b)
                 futs = [pool.submit(self._load_one, i, epoch) for i in rows]
-                window.put(futs)
+                window.put((futs, mask))
 
             upto = min(ahead, num_batches)
             for b in range(upto):
                 submit(b)
-            for b in range(num_batches):
-                futs = window.get()
+            for _ in range(num_batches):
+                futs, mask = window.get()
                 records = [f.result() for f in futs]
                 if upto < num_batches:
                     submit(upto)
                     upto += 1
-                yield self._collate(records, L if self.pad_final else None)
+                yield self._collate(records, mask)

@@ -28,16 +28,33 @@ def device_prefetch(
     q: queue.Queue = queue.Queue(maxsize=depth)
     _SENTINEL = object()
     err: list[BaseException] = []
+    cancelled = threading.Event()
 
     def producer():
         try:
             for host_batch in batches:
-                q.put(mesh_lib.global_array_from_host_local(host_batch, mesh))
+                item = mesh_lib.global_array_from_host_local(host_batch, mesh)
+                # Bounded put that aborts when the consumer goes away, so an
+                # abandoned iterator can't leave this thread (and `depth`
+                # device batches) parked on a full queue forever.
+                while not cancelled.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancelled.is_set():
+                    return
         except BaseException as e:  # propagate into the consumer
             err.append(e)
         finally:
-            q.put(_SENTINEL)
-
+            while True:  # sentinel put must not block either
+                try:
+                    q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    if cancelled.is_set():
+                        break
     thread = threading.Thread(target=producer, daemon=True, name="device-prefetch")
     thread.start()
     try:
@@ -49,4 +66,10 @@ def device_prefetch(
                 return
             yield item
     finally:
-        thread.join(timeout=1.0)
+        cancelled.set()
+        while not q.empty():  # release device buffers held by the queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=2.0)

@@ -1,4 +1,15 @@
 from distributed_training_pytorch_tpu.models.vgg import VGG16, ConvBlock  # noqa: F401
+from distributed_training_pytorch_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18Slim,
+    ResNet50,
+)
+from distributed_training_pytorch_tpu.models.vit import ViT, ViTB16, ViTTiny  # noqa: F401
+from distributed_training_pytorch_tpu.models.convnext import (  # noqa: F401
+    ConvNeXt,
+    ConvNeXtL,
+    ConvNeXtTiny,
+)
 
 
 def create_model(name: str, num_classes: int, **kwargs):
@@ -7,9 +18,9 @@ def create_model(name: str, num_classes: int, **kwargs):
     if name in ("vgg16", "vgg"):
         return VGG16(num_classes=num_classes, **kwargs)
     if name in ("resnet50", "resnet"):
-        raise NotImplementedError("resnet50 is not implemented yet")
+        return ResNet50(num_classes=num_classes, **kwargs)
     if name in ("vit", "vit-b/16", "vit_b16", "vitb16"):
-        raise NotImplementedError("vit-b/16 is not implemented yet")
+        return ViTB16(num_classes=num_classes, **kwargs)
     if name in ("convnext-l", "convnext_l", "convnextl", "convnext"):
-        raise NotImplementedError("convnext-l is not implemented yet")
+        return ConvNeXtL(num_classes=num_classes, **kwargs)
     raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,120 @@
+"""ConvNeXt in Flax — BASELINE.json config 5 (ConvNeXt-L / ImageNet-21k,
+bf16 + gradient accumulation).
+
+Not in the reference (its only model is VGG16); built per the driver's
+scale-out configs. Block = 7x7 depthwise conv -> LayerNorm -> 1x1 expand (4x)
+-> GELU -> 1x1 project, with a learnable per-channel LayerScale and stochastic
+depth on the residual branch (Liu et al. 2022 recipe). TPU-first choices:
+NHWC, depthwise conv via ``feature_group_count`` (lowers to XLA:TPU's native
+grouped conv), bf16 activation knob with float32 params/LN statistics, and
+stochastic depth as a per-sample Bernoulli mask fused into the residual add.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class DropPath(nn.Module):
+    """Stochastic depth: drop the whole residual branch per sample."""
+
+    rate: float
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        if not train or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("droppath")
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class ConvNeXtBlock(nn.Module):
+    dim: int
+    drop_path: float = 0.0
+    layer_scale_init: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        y = nn.Conv(
+            self.dim,
+            (7, 7),
+            padding=[(3, 3), (3, 3)],
+            feature_group_count=self.dim,  # depthwise
+            dtype=self.dtype,
+        )(x)
+        y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(y)
+        y = nn.Dense(4 * self.dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        gamma = self.param(
+            "layer_scale",
+            nn.initializers.constant(self.layer_scale_init),
+            (self.dim,),
+            jnp.float32,
+        )
+        y = y * gamma.astype(y.dtype)
+        y = DropPath(self.drop_path)(y, train=train)
+        return x + y
+
+
+class ConvNeXt(nn.Module):
+    """ConvNeXt; ``depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536)`` is -L."""
+
+    num_classes: int = 1000
+    depths: Sequence[int] = (3, 3, 27, 3)
+    dims: Sequence[int] = (192, 384, 768, 1536)
+    drop_path_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype)
+        # Stem: 4x4 stride-4 patchify conv + LN.
+        x = nn.Conv(self.dims[0], (4, 4), strides=(4, 4), dtype=self.dtype)(x)
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        # Linearly increasing stochastic-depth schedule over all blocks.
+        total_blocks = sum(self.depths)
+        rates = np.linspace(0.0, self.drop_path_rate, total_blocks)  # static schedule
+        block = 0
+        for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if stage > 0:
+                x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(x)
+                x = nn.Conv(dim, (2, 2), strides=(2, 2), dtype=self.dtype)(x)
+            for _ in range(depth):
+                x = ConvNeXtBlock(
+                    dim, drop_path=float(rates[block]), dtype=self.dtype
+                )(x, train=train)
+                block += 1
+        x = x.mean(axis=(1, 2))
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.Dense(self.num_classes, kernel_init=nn.initializers.normal(0.02))(
+            x.astype(jnp.float32)
+        )
+        return x
+
+
+def ConvNeXtL(num_classes: int = 21841, dtype: Any = jnp.float32, **kw) -> ConvNeXt:
+    """ConvNeXt-Large; default head sized for ImageNet-21k (BASELINE config 5)."""
+    return ConvNeXt(
+        num_classes=num_classes,
+        depths=(3, 3, 27, 3),
+        dims=(192, 384, 768, 1536),
+        dtype=dtype,
+        **kw,
+    )
+
+
+def ConvNeXtTiny(num_classes: int = 10, dtype: Any = jnp.float32, **kw) -> ConvNeXt:
+    """Small variant for tests (not the official ConvNeXt-T)."""
+    return ConvNeXt(
+        num_classes=num_classes, depths=(1, 1, 2, 1), dims=(16, 32, 64, 128), dtype=dtype, **kw
+    )

@@ -1,0 +1,116 @@
+"""ResNet in Flax — BASELINE.json config 3 (ResNet-50 / ImageNet-1k, DP).
+
+The reference's only model is VGG16 (``model/vgg16.py``); ResNet extends the
+zoo per the driver's scale-out configs (SURVEY.md §7 step 8). TPU-first
+choices: NHWC layout, bfloat16 activation knob with float32 params and
+float32 BatchNorm statistics, and *global* batch statistics for free — under
+``jit`` with a data-sharded batch, BN's mean/var reductions span the global
+batch (XLA inserts the cross-device collective), which DDP only approximates
+with SyncBatchNorm.
+
+BatchNorm running stats live in the ``batch_stats`` collection and flow
+through ``TrainState.model_state`` (the engine threads mutable collections —
+``train/engine.py`` ``make_supervised_loss``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (x4), residual add, post-add ReLU."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, kernel_init=conv_kernel_init
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        # Zero-init the last BN scale: identity residual at init (He et al.).
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet; ``stage_sizes=(3, 4, 6, 3)`` is ResNet-50."""
+
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=conv_kernel_init,
+        )(x)
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block in range(num_blocks):
+                x = BottleneckBlock(
+                    self.width * (2**stage),
+                    strides=2 if stage > 0 and block == 0 else 1,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.normal(0.01),
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3), dtype=dtype)
+
+
+def ResNet18Slim(num_classes: int = 10, dtype: Any = jnp.float32) -> ResNet:
+    """Small bottleneck variant for tests/smoke runs (not torch ResNet-18)."""
+    return ResNet(num_classes=num_classes, stage_sizes=(1, 1, 1, 1), width=16, dtype=dtype)

@@ -1,0 +1,171 @@
+"""Vision Transformer in Flax — BASELINE.json config 4 (ViT-B/16 / ImageNet).
+
+Not in the reference (its only model is VGG16); built per the driver's
+scale-out configs. TPU-first choices: bfloat16 activation knob, attention as
+batched MXU matmuls, and an optional fused-attention path (``ops.pallas``)
+the module picks when the kernel supports the shapes; sequence dimension kept
+shardable for the ``seq`` mesh axis (ring attention lives in ``parallel``;
+ViT's 197-token sequences don't need it — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        out_dim = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(out_dim, dtype=self.dtype)(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+def dot_product_attention(q, k, v, *, dtype=jnp.float32):
+    """Plain softmax attention: [B, T, H, D] inputs, MXU-batched matmuls,
+    float32 softmax accumulation."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    weights = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    # Optional fused kernel: (q, k, v) -> out, same [B, T, H, D] layout.
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        dim = x.shape[-1]
+        assert dim % self.num_heads == 0
+        head_dim = dim // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        else:
+            out = dot_product_attention(q, k, v, dtype=self.dtype)
+        out = nn.DenseGeneral(dim, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+        return nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MultiHeadAttention(
+            self.num_heads,
+            self.dropout_rate,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+        )(y, train=train)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MlpBlock(self.mlp_dim, self.dropout_rate, dtype=self.dtype)(y, train=train)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT with learned position embeddings and a class token."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        B, H, W, _ = x.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(f"input {H}x{W} not divisible by patch size {p}")
+        x = x.astype(self.dtype)
+        # Patch embedding as a strided conv (one MXU matmul per patch grid).
+        x = nn.Conv(
+            self.hidden_dim,
+            (p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.hidden_dim)  # [B, T, D]
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.hidden_dim), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.hidden_dim)).astype(x.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.hidden_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for _ in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                self.dropout_rate,
+                dtype=self.dtype,
+                attention_fn=self.attention_fn,
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x[:, 0]  # class token
+        x = nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros)(x.astype(jnp.float32))
+        return x
+
+
+def ViTB16(num_classes: int = 1000, dtype: Any = jnp.float32, **kw) -> ViT:
+    return ViT(
+        num_classes=num_classes,
+        patch_size=16,
+        hidden_dim=768,
+        depth=12,
+        num_heads=12,
+        mlp_dim=3072,
+        dtype=dtype,
+        **kw,
+    )
+
+
+def ViTTiny(num_classes: int = 10, dtype: Any = jnp.float32, **kw) -> ViT:
+    """Small variant for tests."""
+    return ViT(
+        num_classes=num_classes,
+        patch_size=4,
+        hidden_dim=32,
+        depth=2,
+        num_heads=4,
+        mlp_dim=64,
+        dtype=dtype,
+        **kw,
+    )

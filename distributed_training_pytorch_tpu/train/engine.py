@@ -45,7 +45,9 @@ def make_supervised_loss(model, criterion: Callable) -> LossFn:
         mutable = list(model_state) if train else []
         kwargs = {"mutable": mutable} if mutable else {}
         if train:
-            kwargs["rngs"] = {"dropout": rng}
+            # dropout + droppath (stochastic depth, ConvNeXt) streams; Flax
+            # ignores streams a model doesn't declare.
+            kwargs["rngs"] = {"dropout": rng, "droppath": jax.random.fold_in(rng, 1)}
         out = model.apply(variables, batch["image"], train=train, **kwargs)
         outputs, new_model_state = out if mutable else (out, model_state)
         loss, metrics = criterion(outputs, batch)

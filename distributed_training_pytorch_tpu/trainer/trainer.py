@@ -123,9 +123,21 @@ class Trainer:
         self.world_size = self.mesh.devices.size
         self.local_batch_size = batch_size // jax.process_count()
 
-        # Build hooks (``:38-41``) — model/criterion/schedule/optimizer.
+        # Build hooks (``:38-41``) — model/criterion first, then datasets
+        # (so ``build_scheduler`` can size per-epoch schedules from
+        # ``len(self.train_dataset)`` without re-scanning), then
+        # schedule/optimizer/engine.
         self.model = self.build_model()
         self.criterion = self.build_criterion()
+
+        # Datasets + loaders (``:56-71``).
+        self.train_dataset = self.build_train_dataset()
+        self.train_dataloader = self.build_dataloader(self.train_dataset, phase="train")
+        self.val_dataloader = None
+        if have_validate:
+            self.val_dataset = self.build_val_dataset()
+            self.val_dataloader = self.build_dataloader(self.val_dataset, phase="val")
+
         schedule = self.build_scheduler()
         if schedule is None:
             schedule = optax.constant_schedule(0.0)
@@ -141,15 +153,6 @@ class Trainer:
             accum_steps=accum_steps,
             schedule=self.schedule,
         )
-
-        # Datasets + loaders (``:56-71``). Train first: example-input inference
-        # for lazy Flax init may read the train source.
-        self.train_dataset = self.build_train_dataset()
-        self.train_dataloader = self.build_dataloader(self.train_dataset, phase="train")
-        self.val_dataloader = None
-        if have_validate:
-            self.val_dataset = self.build_val_dataset()
-            self.val_dataloader = self.build_dataloader(self.val_dataset, phase="val")
 
         # State init (replaces model.to(device) + DDP param broadcast).
         example = self.build_example_input()
@@ -282,10 +285,13 @@ class Trainer:
         metrics (pad-mask aware). Twin of ``trainer/trainer.py:184-206``."""
         sums: dict[str, float] = {}
         weight_total = 0.0
-        for host_batch in self.val_dataloader:
+        for b, host_batch in enumerate(self.val_dataloader):
             host_batch = self.preprocess_batch(host_batch)
-            if isinstance(host_batch, dict) and "mask" in host_batch:
-                weight = float(np.sum(host_batch["mask"]))
+            # Weight by the batch's GLOBAL real-row count — identical on every
+            # process (a host-local mask sum would diverge across hosts on the
+            # padded final batch and break collective best-checkpoint decisions).
+            if hasattr(self.val_dataloader, "global_real_count"):
+                weight = float(self.val_dataloader.global_real_count(b))
             else:
                 weight = float(len(next(iter(host_batch.values()))))
             batch = self.engine.shard_batch(host_batch)

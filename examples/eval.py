@@ -86,8 +86,9 @@ def evaluate(
     )
     sums: dict[str, float] = {}
     total = 0.0
-    for host_batch in loader:
-        weight = float(np.sum(host_batch["mask"]))
+    for b, host_batch in enumerate(loader):
+        # Global real-row count: host-independent aggregation weight.
+        weight = float(loader.global_real_count(b))
         metrics = engine.eval_step(state, engine.shard_batch(host_batch))
         for k, v in metrics.items():
             sums[k] = sums.get(k, 0.0) + float(v) * weight

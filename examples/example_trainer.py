@@ -87,7 +87,6 @@ class ExampleTrainer(Trainer):
     def build_scheduler(self):
         # MultiStepLR milestones [50, 100, 200] epochs, gamma 0.1
         # (``example_trainer.py:66``) — converted to per-step boundaries.
-        steps_per_epoch = max(
-            1, len(ImageFolderDataSource(self.train_path, self.labels)) // self.batch_size
-        )
+        # (Datasets are built before this hook, so no re-scan is needed.)
+        steps_per_epoch = max(1, len(self.train_dataset) // self.batch_size)
         return multistep_lr(0.1, [50, 100, 200], gamma=0.1, steps_per_epoch=steps_per_epoch)

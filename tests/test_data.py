@@ -121,6 +121,32 @@ def test_loader_drop_last_vs_pad_final():
     np.testing.assert_array_equal(batches[-1]["x"], [8, 9, 9, 9])
 
 
+def test_loader_pad_final_multihost_uneven_remainder():
+    """Regression: 21 records / global batch 16 / 4 hosts — the 5-row final
+    batch must still give every host exactly L=4 rows, with a globally
+    consistent mask (hosts 2-3 get all-padding rows, not a crash)."""
+    src = ArrayDataSource(x=np.arange(21, dtype=np.int32))
+    loaders = [
+        ShardedLoader(
+            src, 16, shuffle=False, num_workers=0, drop_last=False, pad_final=True,
+            process_index=p, process_count=4,
+        )
+        for p in range(4)
+    ]
+    per_host = [list(ld) for ld in loaders]
+    assert all(len(b) == 2 for b in per_host)
+    final_rows = np.concatenate([per_host[p][1]["x"] for p in range(4)])
+    final_mask = np.concatenate([per_host[p][1]["mask"] for p in range(4)])
+    np.testing.assert_array_equal(final_mask, (np.arange(16) < 5).astype(np.float32))
+    # Real rows 16..20 then the last real row repeated as padding.
+    np.testing.assert_array_equal(final_rows[:5], np.arange(16, 21))
+    np.testing.assert_array_equal(final_rows[5:], np.full(11, 20))
+    # Host-independent aggregation weight.
+    assert loaders[0].global_real_count(0) == 16
+    assert loaders[0].global_real_count(1) == 5
+    assert all(ld.global_real_count(1) == 5 for ld in loaders)
+
+
 def test_loader_threaded_matches_serial(image_root):
     src = ImageFolderDataSource(image_root, ["cat", "dog", "snake"])
     t = train_transform(24, 24, seed=5)

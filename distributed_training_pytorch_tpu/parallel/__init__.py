@@ -10,3 +10,12 @@ from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
     is_coordinator,
     global_array_from_host_local,
 )
+from distributed_training_pytorch_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from distributed_training_pytorch_tpu.parallel.sharding import (  # noqa: F401
+    spec_for_leaf,
+    state_shardings,
+    transformer_tp_rules,
+)

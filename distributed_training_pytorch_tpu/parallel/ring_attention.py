@@ -1,0 +1,157 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Entirely absent from the reference (no attention, no sequence axis —
+SURVEY.md §5 'long-context'); built TPU-first per the driver's long-context
+mandate. Both strategies run inside ``shard_map`` over the mesh's ``seq``
+axis, so a sequence ``s``-times longer than one device's HBM allows fits:
+
+* :func:`ring_attention` — blockwise attention with online softmax; K/V
+  blocks rotate around the ring via ``lax.ppermute`` while each device keeps
+  its Q shard. Compute on block ``i`` overlaps the transfer of block ``i+1``
+  (XLA's latency-hiding scheduler pipelines the permute) — the
+  Liu & Abbeel ring-attention schedule, implemented as a ``lax.scan`` of MXU
+  matmuls rather than a hand-scheduled kernel.
+* :func:`ulysses_attention` — DeepSpeed-Ulysses: ``lax.all_to_all`` swaps the
+  sequence shard for a head shard, runs *dense* local attention per head
+  group, and swaps back. Cheaper collectives for moderate sequence lengths;
+  requires ``num_heads % seq_devices == 0``.
+
+Both take ``[B, T, H, D]`` global arrays (T sharded over ``seq``) and return
+the same layout; numerics match dense attention to float tolerance (tested on
+the 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_training_pytorch_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, bias=None):
+    """One Q-block x K-block attention: returns (unnormalized out, row max,
+    row sumexp) for online-softmax accumulation. Shapes [B, Tq, H, D] x
+    [B, Tk, H, D]."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    m = logits.max(axis=-1)  # [B, H, Tq]
+    p = jnp.exp(logits - m[..., None])
+    l = p.sum(axis=-1)  # [B, H, Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis``. [B, T, H, D]."""
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis!r}")
+    scale = q.shape[-1] ** -0.5
+
+    def kernel(q, k, v):
+        s = lax.psum(1, axis)  # ring size
+        my = lax.axis_index(axis)
+        t_local = q.shape[1]
+        q_pos = my * t_local + jnp.arange(t_local)  # global Q positions
+        perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def block_bias(step):
+            if not causal:
+                return None
+            # Who produced this K/V block: it has moved `step` hops forward.
+            owner = (my - step) % s
+            k_pos = owner * t_local + jnp.arange(t_local)
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, _NEG_INF)
+            return bias[None, None]  # [1, 1, Tq, Tk]
+
+        def merge(acc, step, k_blk, v_blk):
+            o, m, l = acc
+            o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, scale, block_bias(step))
+            m_new = jnp.maximum(m, m_b)  # online softmax merge
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_b - m_new)
+            o = o * alpha.transpose(0, 2, 1)[..., None] + o_b * beta.transpose(0, 2, 1)[..., None]
+            l = l * alpha + l_b * beta
+            return o, m_new, l
+
+        def body(carry, step):
+            # Rotate first, compute after: the own (step-0) block is handled
+            # outside the scan, so no rotation result is ever discarded.
+            o, m, l, k_blk, v_blk = carry
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            o, m, l = merge((o, m, l), step, k_blk, v_blk)
+            return (o, m, l, k_blk, v_blk), None
+
+        B, T, H, D = q.shape
+        o0 = jnp.zeros((B, T, H, D), jnp.float32)
+        m0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, T), jnp.float32)
+        acc = merge((o0, m0, l0), 0, k, v)  # own block, no communication
+        (o, m, l, _, _), _ = lax.scan(body, acc + (k, v), jnp.arange(1, s))
+        o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return o.astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jax.Array:
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all to head-sharded
+    layout, dense local attention, all-to-all back. [B, T, H, D], T sharded
+    on ``axis``; requires H divisible by the axis size."""
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis!r}")
+    s = mesh.shape[axis]
+    if q.shape[2] % s:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by seq devices {s}")
+    scale = q.shape[-1] ** -0.5
+
+    def kernel(q, k, v):
+        # [B, T/s, H, D] -> [B, T, H/s, D]: scatter heads, gather sequence.
+        def seq_to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        T = qh.shape[1]
+        bias = None
+        if causal:
+            pos = jnp.arange(T)
+            bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, _NEG_INF)[None, None]
+        o, m, l = _block_attn(qh, kh, vh, scale, bias)
+        o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return heads_to_seq(o.astype(q.dtype))
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

@@ -1,0 +1,149 @@
+"""Parameter/state sharding rules: FSDP and tensor parallelism via GSPMD.
+
+The reference has exactly one parallelism strategy — DDP data parallelism with
+fully replicated parameters (``trainer/trainer.py:51-52``, SURVEY.md §2c).
+This module is the TPU-native extension to sharded parameters: instead of
+wrapper modules (FSDP) or hand-written collectives (Megatron), parameters get
+:class:`~jax.sharding.PartitionSpec` s and XLA's SPMD partitioner inserts the
+all-gathers / reduce-scatters (ZeRO-3 analog) or TP collectives and overlaps
+them with compute (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives).
+
+Two layers of rules, applied per state leaf:
+
+1. **Explicit rules** — ``(path_regex, PartitionSpec)`` pairs matched against
+   the leaf's tree path (e.g. ``(r"qkv.*kernel", P(None, "tensor"))`` for
+   Megatron-style column-parallel attention projections).
+2. **FSDP fallback** — when the mesh has a nontrivial ``fsdp`` axis, shard the
+   largest divisible dimension of any leaf with >= ``fsdp_min_size`` elements;
+   smaller leaves stay replicated (per-parameter ZeRO-3 with a size cutoff).
+
+Optimizer state (momentum etc.) mirrors the param tree inside optax's state
+pytrees, so the same path matching shards it identically — the optimizer
+update stays fully local, like ZeRO's sharded optimizer states.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_pytorch_tpu.parallel.mesh import FSDP_AXIS, TENSOR_AXIS
+
+_logger = logging.getLogger(__name__)
+
+# A rule: (regex matched against the leaf path, spec to apply).
+Rule = tuple[str, P]
+
+
+def _spec_fits(spec: P, shape: tuple[int, ...], mesh: Mesh) -> bool:
+    """A spec fits when every named dim exists in the mesh and divides the
+    corresponding array dimension."""
+    if len(spec) > len(shape):
+        return False
+    for dim, names in zip(shape, spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        total = 1
+        for name in names:
+            if name not in mesh.shape:
+                return False
+            total *= mesh.shape[name]
+        if dim % total:
+            return False
+    return True
+
+
+def _fsdp_spec(shape: tuple[int, ...], mesh: Mesh, axis: str, min_size: int) -> P:
+    """Shard the largest divisible dim over ``axis``; replicate if none fits."""
+    if axis not in mesh.shape or mesh.shape[axis] <= 1:
+        return P()
+    size = 1
+    for d in shape:
+        size *= d
+    if size < min_size:
+        return P()
+    n = mesh.shape[axis]
+    order = sorted(range(len(shape)), key=lambda i: shape[i], reverse=True)
+    for i in order:
+        if shape[i] % n == 0:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+def spec_for_leaf(
+    path: str,
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    rules: Sequence[Rule] = (),
+    *,
+    fsdp_axis: str = FSDP_AXIS,
+    fsdp_min_size: int = 2**18,
+) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if _spec_fits(spec, shape, mesh):
+                return spec
+            # An explicit rule that matched but doesn't divide the array is
+            # almost always a config mistake (e.g. heads % tensor != 0) that
+            # would otherwise silently disable TP — say so loudly.
+            _logger.warning(
+                "sharding rule %r matched %s (shape %s) but spec %s does not fit "
+                "mesh %s — falling back to FSDP/replicated",
+                pattern, path, shape, spec, dict(mesh.shape),
+            )
+            break
+    return _fsdp_spec(shape, mesh, fsdp_axis, fsdp_min_size)
+
+
+def state_shardings(
+    state: Any,
+    mesh: Mesh,
+    rules: Sequence[Rule] = (),
+    *,
+    fsdp_axis: str = FSDP_AXIS,
+    fsdp_min_size: int = 2**18,
+) -> Any:
+    """NamedSharding tree matching ``state`` (a TrainState or any pytree of
+    arrays / ShapeDtypeStructs). Scalars and sub-2D leaves typically fall out
+    replicated via the size cutoff."""
+
+    def leaf_sharding(key_path, leaf):
+        path = jax.tree_util.keystr(key_path)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            return NamedSharding(mesh, P())
+        spec = spec_for_leaf(
+            path, shape, mesh, rules, fsdp_axis=fsdp_axis, fsdp_min_size=fsdp_min_size
+        )
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, state)
+
+
+# -- predefined tensor-parallel rule sets ----------------------------------
+
+def transformer_tp_rules(tensor_axis: str = TENSOR_AXIS) -> list[Rule]:
+    """Megatron-style TP for the ViT/transformer blocks in ``models.vit``:
+    column-parallel qkv + MLP-in (output features sharded), row-parallel
+    attention-out + MLP-out (input features sharded; XLA inserts the
+    all-reduce the row-parallel matmul needs). Biases of column-parallel
+    layers shard on their feature dim."""
+    return [
+        # qkv DenseGeneral kernel [D, 3, H, d] -> heads sharded.
+        (r"qkv.*kernel", P(None, None, tensor_axis, None)),
+        (r"qkv.*bias", P(None, tensor_axis, None)),
+        # attention out DenseGeneral kernel [H, d, D] -> heads (input) sharded.
+        (r"\bout\b.*kernel", P(tensor_axis, None, None)),
+        # MLP: first Dense column-parallel, second row-parallel.
+        (r"MlpBlock_\d+.*Dense_0.*kernel", P(None, tensor_axis)),
+        (r"MlpBlock_\d+.*Dense_0.*bias", P(tensor_axis)),
+        (r"MlpBlock_\d+.*Dense_1.*kernel", P(tensor_axis, None)),
+    ]

@@ -6,9 +6,12 @@ DDP forward, backward with bucketed NCCL all-reduce, optimizer step, and a
 ``loss.item()`` device sync *per step*, this engine compiles the whole step —
 loss, ``jax.grad``, cross-device gradient reduction, and the optax update —
 into one XLA program over a named mesh. Gradient synchronization needs no
-explicit collective: the batch is sharded over the ``data`` axis and params are
-replicated, so XLA inserts the all-reduce (and overlaps it) itself. Metrics
-stay on device; the host never blocks per step.
+explicit collective: the batch is sharded over the ``data`` axis and XLA
+inserts (and overlaps) the all-reduce itself. Params are replicated for pure
+DP, or sharded over ``fsdp``/``tensor`` axes per ``parallel.sharding`` rules
+(ZeRO-3 / Megatron-TP analogs) — the step body is identical either way; only
+the sharding annotations change. Metrics stay on device; the host never
+blocks per step.
 
 Gradient accumulation (BASELINE config 5) runs as a ``lax.scan`` over
 microbatches inside the same compiled step.
@@ -16,7 +19,7 @@ microbatches inside the same compiled step.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Mapping
+from typing import Any, Callable, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +27,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.parallel import sharding as sharding_lib
 from distributed_training_pytorch_tpu.train.state import TrainState
 
 # A LossFn maps (params, model_state, batch, rng, train) ->
@@ -73,38 +77,74 @@ class TrainEngine:
         accum_steps: int = 1,
         schedule: optax.Schedule | None = None,
         donate_state: bool = True,
+        sharding_rules: Sequence | None = None,
+        fsdp_min_size: int = 2**18,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.accum_steps = int(accum_steps)
         self.schedule = schedule
+        self.sharding_rules = sharding_rules
+        self.fsdp_min_size = fsdp_min_size
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
         self._replicated = NamedSharding(mesh, P())
+        self._donate = (0,) if donate_state else ()
+        # Param/opt-state sharding tree — computed from the state structure on
+        # first use (init_state or the first step); replicated for pure DP,
+        # rule/FSDP-sharded otherwise (parallel.sharding).
+        self._state_sharding = None
+        self._train_step = None
+        self._eval_step = None
 
-        donate = (0,) if donate_state else ()
+    def state_sharding(self, state_or_abstract) -> Any:
+        """The NamedSharding tree this engine lays state out with.
+
+        Contract: one engine serves ONE state structure — the tree is computed
+        from the first state seen (init_state or the first step) and cached;
+        later calls return that cached tree regardless of argument."""
+        if self._state_sharding is None:
+            if self.sharding_rules is None and not any(
+                self.mesh.shape.get(a, 1) > 1 for a in (mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS)
+            ):
+                self._state_sharding = self._replicated
+            else:
+                self._state_sharding = sharding_lib.state_shardings(
+                    state_or_abstract,
+                    self.mesh,
+                    self.sharding_rules or (),
+                    fsdp_min_size=self.fsdp_min_size,
+                )
+        return self._state_sharding
+
+    def _build_steps(self, state) -> None:
+        if self._train_step is not None:
+            return
+        state_sharding = self.state_sharding(state)
         self._train_step = jax.jit(
             self._train_step_impl,
-            in_shardings=(self._replicated, self._batch_sharding),
-            out_shardings=(self._replicated, self._replicated),
-            donate_argnums=donate,
+            in_shardings=(state_sharding, self._batch_sharding),
+            out_shardings=(state_sharding, self._replicated),
+            donate_argnums=self._donate,
         )
         self._eval_step = jax.jit(
             self._eval_step_impl,
-            in_shardings=(self._replicated, self._batch_sharding),
+            in_shardings=(state_sharding, self._batch_sharding),
             out_shardings=self._replicated,
         )
 
     # -- state ------------------------------------------------------------
 
     def init_state(self, rng: jax.Array, init_fn: Callable[[jax.Array], dict]) -> TrainState:
-        """Initialize params on device, replicated over the mesh.
+        """Initialize state directly into this engine's sharded layout.
 
         ``init_fn(rng) -> variables`` (a Flax ``model.init`` closure). The
         analog of ``build_model`` + ``model.to(local_rank)`` + the DDP ctor's
-        initial parameter broadcast (``trainer/trainer.py:38,51-52``) — here
-        init is jitted with replicated output sharding, so every device holds
-        identical params without an explicit broadcast.
+        initial parameter broadcast (``trainer/trainer.py:38,51-52``) — init
+        is jitted with the engine's state sharding as output sharding:
+        replicated for pure DP (every device holds identical params, no
+        explicit broadcast), or fsdp/tensor-sharded per the engine's rules —
+        in which case NO device ever holds the full parameter set.
         """
         init_rng, state_rng = jax.random.split(rng)
 
@@ -119,7 +159,12 @@ class TrainEngine:
                 rng=state_rng,
             )
 
-        return jax.jit(make, out_shardings=self._replicated)(init_rng, state_rng)
+        # Shape-infer the state, derive its sharding tree, then materialize
+        # directly into that layout — params larger than one device's HBM
+        # never exist unsharded anywhere.
+        abstract = jax.eval_shape(make, init_rng, state_rng)
+        out_shardings = self.state_sharding(abstract)
+        return jax.jit(make, out_shardings=out_shardings)(init_rng, state_rng)
 
     # -- compiled bodies --------------------------------------------------
 
@@ -194,12 +239,14 @@ class TrainEngine:
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         """One compiled optimizer step on a global batch. Metrics are device
         arrays (global means) — call ``jax.device_get`` only when logging."""
+        self._build_steps(state)
         return self._train_step(state, batch)
 
     def eval_step(self, state: TrainState, batch) -> dict:
         """Collective validation step — replaces the reference's rank-0-only,
         non-distributed ``validate`` (``trainer/trainer.py:184-206``): every
         device evaluates its shard and metrics reduce globally."""
+        self._build_steps(state)
         return self._eval_step(state, batch)
 
     def shard_batch(self, batch):
@@ -212,4 +259,5 @@ class TrainEngine:
         executable (callable as ``compiled(state, batch)``). Supported surface
         for benchmarking: ``compiled.cost_analysis()`` exposes XLA's FLOP
         estimate for MFU math."""
+        self._build_steps(state)
         return self._train_step.lower(state, batch).compile()

@@ -1,0 +1,188 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (SURVEY.md §4):
+ring/Ulysses attention parity vs dense attention, FSDP state sharding, and
+tensor-parallel ViT matching the pure-DP run numerically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_pytorch_tpu.models.vit import ViTTiny, dot_product_attention
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.parallel import (
+    ring_attention,
+    state_shardings,
+    transformer_tp_rules,
+    ulysses_attention,
+)
+from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+
+
+def qkv(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(*shape), jnp.float32) for _ in range(3))
+
+
+@pytest.fixture
+def seq_mesh(devices):
+    return mesh_lib.create_mesh({mesh_lib.SEQ_AXIS: 8}, devices=devices)
+
+
+def test_ring_attention_matches_dense(seq_mesh):
+    q, k, v = qkv((2, 64, 4, 8))
+    dense = dot_product_attention(q, k, v)
+    ring = ring_attention(q, k, v, seq_mesh)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=2e-5)
+
+
+def test_ring_attention_causal(seq_mesh):
+    q, k, v = qkv((1, 32, 2, 8), seed=1)
+    ring = ring_attention(q, k, v, seq_mesh, causal=True)
+    # Dense causal reference.
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    dense = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=2e-5)
+
+
+def test_ulysses_attention_matches_dense(seq_mesh):
+    q, k, v = qkv((2, 64, 8, 4), seed=2)  # 8 heads = seq devices
+    dense = dot_product_attention(q, k, v)
+    uly = ulysses_attention(q, k, v, seq_mesh)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5)
+
+
+def test_ulysses_causal_matches_ring(seq_mesh):
+    q, k, v = qkv((1, 64, 8, 4), seed=3)
+    a = ulysses_attention(q, k, v, seq_mesh, causal=True)
+    b = ring_attention(q, k, v, seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_ulysses_rejects_bad_head_count(seq_mesh):
+    q, k, v = qkv((1, 64, 6, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q, k, v, seq_mesh)
+
+
+# -- sharding rules ---------------------------------------------------------
+
+
+def test_fsdp_spec_shards_largest_divisible_dim(devices):
+    mesh = mesh_lib.create_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.FSDP_AXIS: 4}, devices=devices
+    )
+    from distributed_training_pytorch_tpu.parallel.sharding import spec_for_leaf
+
+    # Large 2D kernel: largest dim (4096) sharded over fsdp.
+    assert spec_for_leaf("kernel", (1024, 4096), mesh) == P(None, "fsdp")
+    # Below size cutoff: replicated.
+    assert spec_for_leaf("bias", (128,), mesh) == P()
+    # Indivisible large dim: falls to next divisible dim.
+    assert spec_for_leaf("kernel", (4098, 1024), mesh) == P(None, "fsdp")
+
+
+def test_state_shardings_fsdp_end_to_end(devices):
+    """FSDP engine: params actually land sharded, training still works, and
+    numerics match the replicated run."""
+    mesh_dp = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+    mesh_fsdp = mesh_lib.create_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.FSDP_AXIS: 4}, devices=devices
+    )
+    model = ViTTiny(num_classes=4)
+
+    def criterion(logits, batch):
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"loss": loss}
+
+    def run(mesh, min_size):
+        engine = TrainEngine(
+            make_supervised_loss(model, criterion),
+            optax.sgd(0.05, momentum=0.9),
+            mesh,
+            fsdp_min_size=min_size,
+        )
+        state = engine.init_state(
+            jax.random.key(0), lambda r: model.init(r, jnp.zeros((1, 16, 16, 3)))
+        )
+        rng = np.random.RandomState(0)
+        batch = engine.shard_batch(
+            {
+                "image": rng.randn(16, 16, 16, 3).astype(np.float32),
+                "label": rng.randint(0, 4, size=(16,)).astype(np.int32),
+            }
+        )
+        losses = []
+        for _ in range(3):
+            state, m = engine.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    state_f, losses_f = run(mesh_fsdp, min_size=1024)
+    state_d, losses_d = run(mesh_dp, min_size=2**18)
+    # At least one param leaf is genuinely sharded over fsdp.
+    specs = [
+        l.sharding.spec for l in jax.tree.leaves(state_f.params) if hasattr(l, "sharding")
+    ]
+    assert any("fsdp" in str(s) for s in specs), specs
+    # Momentum (opt_state) shards the same way.
+    opt_specs = [
+        l.sharding.spec for l in jax.tree.leaves(state_f.opt_state) if hasattr(l, "sharding")
+    ]
+    assert any("fsdp" in str(s) for s in opt_specs), opt_specs
+    np.testing.assert_allclose(losses_f, losses_d, rtol=2e-4)
+
+
+def test_tensor_parallel_vit_matches_dp(devices):
+    """Megatron-style TP rules on the ViT: params shard over `tensor`, loss
+    trajectory matches pure DP."""
+    mesh_dp = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+    mesh_tp = mesh_lib.create_mesh(
+        {mesh_lib.DATA_AXIS: 2, mesh_lib.TENSOR_AXIS: 4}, devices=devices
+    )
+    model = ViTTiny(num_classes=4)
+
+    def criterion(logits, batch):
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"loss": loss}
+
+    def run(mesh, rules):
+        engine = TrainEngine(
+            make_supervised_loss(model, criterion),
+            optax.sgd(0.05, momentum=0.9),
+            mesh,
+            sharding_rules=rules,
+        )
+        state = engine.init_state(
+            jax.random.key(0), lambda r: model.init(r, jnp.zeros((1, 16, 16, 3)))
+        )
+        rng = np.random.RandomState(1)
+        batch = engine.shard_batch(
+            {
+                "image": rng.randn(16, 16, 16, 3).astype(np.float32),
+                "label": rng.randint(0, 4, size=(16,)).astype(np.int32),
+            }
+        )
+        losses = []
+        for _ in range(3):
+            state, m = engine.train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    state_t, losses_t = run(mesh_tp, transformer_tp_rules())
+    state_d, losses_d = run(mesh_dp, None)
+    specs = {
+        jax.tree_util.keystr(p): l.sharding.spec
+        for p, l in jax.tree_util.tree_leaves_with_path(state_t.params)
+    }
+    tp_sharded = [k for k, s in specs.items() if "tensor" in str(s)]
+    assert any("qkv" in k for k in tp_sharded), tp_sharded
+    assert any("MlpBlock" in k for k in tp_sharded), tp_sharded
+    np.testing.assert_allclose(losses_t, losses_d, rtol=2e-4)

@@ -1,7 +1,9 @@
 from distributed_training_pytorch_tpu.data.dataset import (  # noqa: F401
     ArrayDataSource,
     ImageFolderDataSource,
+    NativeImageFolderSource,
 )
+from distributed_training_pytorch_tpu.data import native  # noqa: F401
 from distributed_training_pytorch_tpu.data.loader import ShardedLoader  # noqa: F401
 from distributed_training_pytorch_tpu.data.prefetch import device_prefetch  # noqa: F401
 from distributed_training_pytorch_tpu.data.transforms import (  # noqa: F401

@@ -70,6 +70,45 @@ def _decode_image(path: str) -> np.ndarray:
         return np.asarray(Image.open(path).convert("RGB"))
 
 
+class NativeImageFolderSource(ImageFolderDataSource):
+    """Image-folder source whose batches decode/resize/normalize in one call
+    into the native C++ runtime (``data/native.py``) — the no-augmentation
+    (val/eval) hot path. Falls back to the per-record Python transform path
+    inside ``load_batch`` when the native library is unavailable."""
+
+    def __init__(
+        self,
+        data_path: str,
+        labels: Sequence[str],
+        height: int,
+        width: int,
+        mean=None,
+        std=None,
+    ):
+        super().__init__(data_path, labels, transform=None)
+        from distributed_training_pytorch_tpu.data import native, transforms
+
+        self.height, self.width = height, width
+        self.mean = transforms.IMAGENET_MEAN if mean is None else np.asarray(mean, np.float32)
+        self.std = transforms.IMAGENET_STD if std is None else np.asarray(std, np.float32)
+        self._native = native if native.available() else None
+        if self._native is None:
+            self.transform = transforms.eval_transform(height, width)
+
+    def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
+        labels = np.array([self.records[int(i)][1] for i in rows], np.int32)
+        if self._native is not None:
+            paths = [self.records[int(i)][0] for i in rows]
+            images = self._native.decode_resize_normalize(
+                paths, self.height, self.width, self.mean, self.std
+            )
+        else:
+            images = np.stack(
+                [self.transform(super().__getitem__(int(i))["image"]) for i in rows]
+            )
+        return {"image": images, "label": labels}
+
+
 class ArrayDataSource:
     """In-memory source over parallel arrays — the synthetic-data path used by
     tests and benchmarks (SURVEY.md §7 'minimum end-to-end slice')."""

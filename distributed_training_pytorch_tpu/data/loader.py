@@ -99,6 +99,34 @@ class ShardedLoader:
             record["image"] = self.transform(record["image"], epoch=epoch, index=int(index))
         return record
 
+    def _batch_fast_path(self):
+        """Whole-batch production in one call (native C++ runtime): either the
+        source loads batches itself (``load_batch``), or it exposes in-memory
+        ``arrays`` and the transform is batch-capable (``batch_apply``)."""
+        if hasattr(self.source, "load_batch"):
+            return "source"
+        if (
+            self.transform is not None
+            and hasattr(self.transform, "batch_apply")
+            and hasattr(self.source, "arrays")
+        ):
+            return "arrays"
+        return None
+
+    def _produce_batch(self, rows: np.ndarray, mask, epoch: int, fast: str | None) -> dict:
+        if fast == "source":
+            batch = dict(self.source.load_batch(rows, epoch))
+        elif fast == "arrays":
+            batch = {k: v[rows] for k, v in self.source.arrays.items()}
+            if "image" in batch:
+                batch["image"] = self.transform.batch_apply(batch["image"], rows, epoch)
+        else:
+            records = [self._load_one(i, epoch) for i in rows]
+            batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
+        if mask is not None:
+            batch["mask"] = mask
+        return batch
+
     def _collate(self, records: list[dict], mask: np.ndarray | None) -> dict:
         batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
         if mask is not None:
@@ -137,31 +165,41 @@ class ShardedLoader:
                 mask = mask[self._pidx * L : (self._pidx + 1) * L]
             return rows[self._pidx * L : (self._pidx + 1) * L], mask
 
+        fast = self._batch_fast_path()
+
         if self.num_workers <= 0:
             for b in range(num_batches):
                 rows, mask = batch_indices(b)
-                records = [self._load_one(i, epoch) for i in rows]
-                yield self._collate(records, mask)
+                yield self._produce_batch(rows, mask, epoch, fast)
             return
 
         # Thread pool with a bounded in-flight window so decode/augment of
-        # batch b+1..b+2 overlaps consumption of batch b.
+        # batch b+1..b+2 overlaps consumption of batch b. Fast-path batches
+        # are one future each (the native call is internally multithreaded
+        # and GIL-free); the Python path fans out per record.
         with cf.ThreadPoolExecutor(self.num_workers) as pool:
             window: queue.Queue = queue.Queue()
             ahead = 2
 
             def submit(b: int):
                 rows, mask = batch_indices(b)
-                futs = [pool.submit(self._load_one, i, epoch) for i in rows]
-                window.put((futs, mask))
+                if fast is not None:
+                    window.put(
+                        (pool.submit(self._produce_batch, rows, mask, epoch, fast), None)
+                    )
+                else:
+                    futs = [pool.submit(self._load_one, i, epoch) for i in rows]
+                    window.put((futs, mask))
 
             upto = min(ahead, num_batches)
             for b in range(upto):
                 submit(b)
             for _ in range(num_batches):
-                futs, mask = window.get()
-                records = [f.result() for f in futs]
+                item, mask = window.get()
                 if upto < num_batches:
                     submit(upto)
                     upto += 1
-                yield self._collate(records, mask)
+                if fast is not None:
+                    yield item.result()
+                else:
+                    yield self._collate([f.result() for f in item], mask)

@@ -10,6 +10,7 @@ from distributed_training_pytorch_tpu.models.convnext import (  # noqa: F401
     ConvNeXtL,
     ConvNeXtTiny,
 )
+from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer  # noqa: F401
 
 
 def create_model(name: str, num_classes: int, **kwargs):

@@ -80,6 +80,7 @@ class VGG16(nn.Module):
     num_classes: int = 3
     stage_features: Sequence[int] = (64, 128, 256, 512, 512)
     stage_layers: Sequence[int] = (2, 2, 3, 3, 3)
+    classifier_widths: Sequence[int] = (4096, 4096)
     dropout_rate: float = 0.3
     dtype: Any = jnp.float32
 
@@ -96,7 +97,7 @@ class VGG16(nn.Module):
             x = ConvBlock(feats, layers, dtype=self.dtype)(x)
         x = adaptive_avg_pool_2d(x, (7, 7))
         x = x.reshape(x.shape[0], -1)
-        for width in (4096, 4096):
+        for width in self.classifier_widths:
             x = nn.Dense(width, dtype=self.dtype, kernel_init=dense_kernel_init)(x)
             x = nn.relu(x)
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

@@ -19,7 +19,8 @@ offline evaluator — rebuilt TPU-first:
   (replaces ``DistributedSampler`` + ``DataLoader``).
 * ``checkpoint``— Orbax-backed best/last/periodic checkpointing with resume.
 * ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.
-* ``utils``     — logging, profiling, configuration.
+* ``utils``     — logging, profiling/tracing (``utils.profiling``), TPU perf
+  defaults (``utils.tpu``).
 """
 
 __version__ = "0.1.0"

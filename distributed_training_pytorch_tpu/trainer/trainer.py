@@ -89,6 +89,9 @@ class Trainer:
         num_workers: int = 8,
         log_every: int = 50,
         async_checkpoint: bool = True,
+        profile_dir: str | None = None,
+        profile_steps: int = 5,
+        progress: bool = True,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -107,6 +110,15 @@ class Trainer:
         self.num_workers = num_workers
         self.log_every = log_every
         self.cur_epoch = 0
+        # Tracing knob (SURVEY.md §5 tracing entry; analog of the reference's
+        # NCCL flight-recorder buffer, run.sh:8): when set, a jax.profiler
+        # trace of `profile_steps` steady-state steps of the first trained
+        # epoch is written under profile_dir (TensorBoard-loadable; summarize
+        # headlessly with utils.profiling.top_ops).
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
+        self._profiled = False
+        self.progress = progress
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
@@ -263,27 +275,71 @@ class Trainer:
         batches = device_prefetch(
             (self.preprocess_batch(b) for b in self.train_dataloader), self.mesh
         )
+        bar = self._progress_bar(len(self.train_dataloader), f"epoch {epoch + 1}")
         for batch in batches:
+            self._maybe_profile(step_in_epoch)
             self.state, metrics = self.train_step(self.state, batch)
             collected.append(metrics)
             step_in_epoch += 1
+            if bar is not None:
+                # Advancing the bar is host-only; the postfix refreshes at the
+                # log_every sync points (a true per-step live loss would force
+                # the reference's per-step loss.item() sync back in).
+                bar.update(1)
             if self.log_every and step_in_epoch % self.log_every == 0:
                 # The only intra-epoch host sync, every log_every steps.
                 m = {k: float(v) for k, v in collected[-1].items()}
                 rate = step_in_epoch * self.batch_size / (time.perf_counter() - t0)
+                if bar is not None:
+                    bar.set_postfix(m, refresh=False)
+                    bar.clear()  # keep log lines off the live bar row
                 self.log(
                     f"  step {step_in_epoch}/{len(self.train_dataloader)} "
                     f"{m} ({rate:.1f} img/s)"
                 )
+                if bar is not None:
+                    bar.refresh()
+        self._maybe_profile(step_in_epoch, end_of_epoch=True)
+        if bar is not None:
+            bar.close()
         if not collected:
             return {}
         host = jax.device_get(collected)
         return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
 
+    def _progress_bar(self, total: int, desc: str):
+        """Live per-step progress display (reference shows a tqdm bar with live
+        postfix metrics, ``trainer/trainer.py:143,148``). Process 0 only."""
+        if not self.progress or jax.process_index() != 0:
+            return None
+        try:
+            from tqdm import tqdm
+        except ImportError:
+            return None
+        return tqdm(total=total, desc=desc, dynamic_ncols=True, leave=False)
+
+    def _maybe_profile(self, step_in_epoch: int, end_of_epoch: bool = False) -> None:
+        """Trace steps [1, 1+profile_steps) of the first trained epoch —
+        step 0 is excluded so compile time never pollutes the trace."""
+        if self.profile_dir is None or self._profiled is True:
+            return
+        if self._profiled == "tracing" and (
+            end_of_epoch or step_in_epoch >= 1 + self.profile_steps
+        ):
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._profiled = True
+            self.log(f"Profiler trace written to {self.profile_dir}")
+        elif self._profiled is False and not end_of_epoch and step_in_epoch == 1:
+            jax.block_until_ready(self.state.params)
+            os.makedirs(self.profile_dir, exist_ok=True)
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiled = "tracing"
+
     def validate(self) -> dict:
         """Collective validation over the val loader; returns weighted-mean
         metrics (pad-mask aware). Twin of ``trainer/trainer.py:184-206``."""
-        sums: dict[str, float] = {}
+        sums: dict[str, Any] = {}
         weight_total = 0.0
         for b, host_batch in enumerate(self.val_dataloader):
             host_batch = self.preprocess_batch(host_batch)
@@ -296,11 +352,14 @@ class Trainer:
                 weight = float(len(next(iter(host_batch.values()))))
             batch = self.engine.shard_batch(host_batch)
             metrics = self.validate_step(self.state, batch)
-            metrics = {k: float(v) for k, v in metrics.items()}
-            for k, v in metrics.items():
+            # Weighted sums accumulate as device scalars; the epoch's single
+            # host sync is the device_get below (the reference syncs per batch
+            # via .item(), ``example_trainer.py:101-102``).
+            for k, v in dict(metrics).items():
                 sums[k] = sums.get(k, 0.0) + v * weight
             weight_total += weight
-        avg = {k: v / max(weight_total, 1.0) for k, v in sums.items()}
+        sums = jax.device_get(sums)
+        avg = {k: float(v) / max(weight_total, 1.0) for k, v in sums.items()}
         msg = "VALIDATE RESULTS: "
         for k, v in avg.items():
             msg += f" | {k} = {v} | "

@@ -42,6 +42,26 @@ def dot_product_attention(q, k, v, *, dtype=jnp.float32):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def default_attention_fn(use_flash: Optional[bool] = None) -> Optional[Callable]:
+    """Resolve the attention path: the Pallas flash kernel (``ops.pallas``)
+    when ``use_flash`` is True (forced, any sequence length), or None (plain
+    XLA softmax attention) when False. ``None`` auto-selects: on TPU backends,
+    the shape-aware adapter that uses the kernel where it beats XLA
+    (T >= ``ops.pallas.FLASH_MIN_SEQ_LEN``) and the plain path below that.
+
+    Call only at trace/apply time (it touches ``jax.default_backend()``, which
+    initializes backends — too early at model-construction time for
+    ``jax.distributed`` setups).
+    """
+    if use_flash is False:
+        return None
+    from distributed_training_pytorch_tpu.ops.pallas import make_attention_fn
+
+    if use_flash is True:
+        return make_attention_fn(min_seq_len=1)  # explicit: force the kernel
+    return make_attention_fn() if jax.default_backend() == "tpu" else None
+
+
 class MultiHeadAttention(nn.Module):
     num_heads: int
     dropout_rate: float = 0.0
@@ -100,6 +120,12 @@ class ViT(nn.Module):
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
+    # tri-state flash knob, resolved lazily at apply time when attention_fn is
+    # not given: True = force the Pallas kernel, False = plain XLA, None =
+    # auto (kernel on TPU for long sequences). Lazy so that merely
+    # constructing a model never initializes JAX backends (which would break
+    # a later jax.distributed.initialize()).
+    use_flash: Optional[bool] = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -130,13 +156,16 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        attention_fn = self.attention_fn
+        if attention_fn is None and self.use_flash is not False:
+            attention_fn = default_attention_fn(self.use_flash)
         for _ in range(self.depth):
             x = EncoderBlock(
                 self.num_heads,
                 self.mlp_dim,
                 self.dropout_rate,
                 dtype=self.dtype,
-                attention_fn=self.attention_fn,
+                attention_fn=attention_fn,
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x[:, 0]  # class token
@@ -144,8 +173,18 @@ class ViT(nn.Module):
         return x
 
 
-def ViTB16(num_classes: int = 1000, dtype: Any = jnp.float32, **kw) -> ViT:
+def ViTB16(
+    num_classes: int = 1000,
+    dtype: Any = jnp.float32,
+    use_flash: Optional[bool] = None,
+    **kw,
+) -> ViT:
+    """BASELINE config 4. ``use_flash=None`` (auto) routes attention through
+    the shape-aware Pallas adapter on TPU — at this model's T=197 that resolves
+    to the plain XLA path (measured faster below ``FLASH_MIN_SEQ_LEN``);
+    ``use_flash=True`` forces the fused kernel regardless of shape."""
     return ViT(
+        use_flash=use_flash,
         num_classes=num_classes,
         patch_size=16,
         hidden_dim=768,

@@ -4,6 +4,16 @@ from distributed_training_pytorch_tpu.ops.losses import (  # noqa: F401
     weighted_mean,
 )
 from distributed_training_pytorch_tpu.ops.metrics import accuracy, top_k_accuracy  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy re-export: pulling in jax.experimental.pallas costs real import
+    # time, and most ops consumers only want losses/metrics/schedules.
+    if name in ("flash_attention", "make_attention_fn"):
+        from distributed_training_pytorch_tpu.ops import pallas
+
+        return getattr(pallas, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from distributed_training_pytorch_tpu.ops.schedules import (  # noqa: F401
     multistep_lr,
     warmup_cosine_lr,

@@ -1,0 +1,392 @@
+"""Pallas TPU kernels: fused flash attention.
+
+The reference gets its fused kernels from cuDNN via torch
+(``/root/reference/requirements.txt:12-24``, ``model/vgg16.py:9-14``); the
+TPU-native equivalent obligation (SURVEY.md §2b) is custom Pallas kernels
+where plain XLA underperforms — attention being the canonical case: a
+materialized ``[B, H, T, T]`` score tensor is HBM-bandwidth-bound, while the
+flash formulation streams K/V blocks through VMEM with an online softmax and
+never materializes the scores.
+
+Public surface:
+
+* :func:`flash_attention` — ``[B, T, H, D]`` q/k/v -> ``[B, T, H, D]``, same
+  contract as ``models.vit.dot_product_attention`` (scale = D**-0.5, optional
+  causal mask), differentiable (custom VJP, flash backward kernels).
+* :func:`make_attention_fn` — adapter for ``models.vit.MultiHeadAttention``'s
+  ``attention_fn`` hook; picks the kernel on TPU and the plain XLA path
+  elsewhere.
+
+Kernel design (see /opt/skills/guides/pallas_guide.md): grid over
+``(batch, head, q-block)``; K/V live in VMEM as whole ``[T, D]`` slabs per
+(batch, head) — fine through ~32k tokens at D=64/128; beyond that, sequence
+parallelism (``parallel.ring_attention``) shards T across chips and each shard
+re-enters this kernel. Softmax statistics are carried in float32; matmuls run
+on the MXU with ``preferred_element_type=float32``. The backward pass is the
+standard flash decomposition: a delta precompute (``rowsum(dO * O)``), a
+dq kernel gridded over q-blocks, and a dk/dv kernel gridded over k-blocks —
+so the [T, T] score matrix is never materialized in either direction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative logit for masked positions (f32-safe)
+
+_DEFAULT_BLOCK_Q = 128
+_DEFAULT_BLOCK_K = 128
+
+
+def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, seq_len, causal):
+    """One q-block against all k-blocks, online softmax. Refs are
+    (1, 1, bq, D) / (1, 1, Tp, D) blocks; statistics in f32."""
+    bq = q_ref.shape[2]
+    d = q_ref.shape[3]
+    t_pad = k_ref.shape[2]
+    n_k = t_pad // block_k
+
+    # Matmuls run in the input dtype (bf16 in production — one MXU pass; an
+    # f32 cast would force the 3x-slower f32 path) with f32 accumulation;
+    # softmax statistics and the scale multiply stay f32.
+    q = q_ref[0, 0]  # [bq, D]
+    q_idx = pl.program_id(2) * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]  # [bk, D]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]  # [bk, D]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk] f32
+        k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = k_idx < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_idx >= k_idx)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # [bq, 1]
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        alpha = jnp.exp(m - m_new)  # [bq, 1]
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+    # Padded q rows (and fully-masked causal rows cannot occur: row i always
+    # sees k=i) have l=0 only when the whole row was padding; guard the divide.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    # Stats live as [1, bq] lane-major rows: a [B, H, 1, T] buffer pads only
+    # its singleton sublane dim (8x on 1), where a [..., T, 1] layout would
+    # pad the lane dim 128x (measured 384MB/layer on ViT-B — OOM).
+    lse_ref[0, 0] = jnp.transpose(m + jnp.log(l_safe), (1, 0))  # [1, bq]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k, seq_len, causal
+):
+    """dq for one q-block: dq_i = scale * sum_j (p_ij * (dp_ij - delta_i)) k_j."""
+    bq = q_ref.shape[2]
+    d = q_ref.shape[3]
+    t_pad = k_ref.shape[2]
+    n_k = t_pad // block_k
+
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]  # [bq, D]
+    lse = jnp.transpose(lse_ref[0, 0], (1, 0))  # [1, bq] -> [bq, 1]
+    delta = jnp.transpose(delta_ref[0, 0], (1, 0))
+    q_idx = pl.program_id(2) * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = k_idx < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_idx >= k_idx)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta)).astype(k.dtype)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, n_k, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, seq_len, causal
+):
+    """dk/dv for one k-block, looping over q-blocks:
+    dv_j = sum_i p_ij^T do_i ; dk_j = scale * sum_i (p_ij * (dp_ij - delta_i))^T q_i."""
+    bk = k_ref.shape[2]
+    d = k_ref.shape[3]
+    t_pad = q_ref.shape[2]
+    n_q = t_pad // block_q
+
+    k = k_ref[0, 0]  # [bk, D]
+    v = v_ref[0, 0]
+    k_idx = pl.program_id(2) * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = jnp.transpose(lse_ref[0, 0, :, pl.ds(i * block_q, block_q)], (1, 0))
+        delta = jnp.transpose(delta_ref[0, 0, :, pl.ds(i * block_q, block_q)], (1, 0))
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk] f32
+        q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        mask = k_idx < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_idx >= k_idx)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]; padded q rows have lse=0, p=exp(NEG_INF)=0
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bk, D]
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_q, body, (dk0, dv0))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Wrapper with custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _to_bhtd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))  # [B,T,H,D] -> [B,H,T,D]
+
+
+def _from_bhtd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    scale = d**-0.5
+    bq = min(block_q, max(t, 1))
+    bk = min(block_k, max(t, 1))
+    tq_pad = pl.cdiv(t, bq) * bq
+    tk_pad = pl.cdiv(t, bk) * bk
+    qt = _pad_to(_to_bhtd(q), tq_pad, 2)
+    kt = _pad_to(_to_bhtd(k), tk_pad, 2)
+    vt = _pad_to(_to_bhtd(v), tk_pad, 2)
+    n_q = tq_pad // bq
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_k=bk, seq_len=t, causal=causal
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, tq_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return o[:, :, :t, :], lse[:, :, :, :t], (qt, kt, vt)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return _from_bhtd(o)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse, (qt, kt, vt) = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return _from_bhtd(o), (qt, kt, vt, o, lse, q.shape)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    qt, kt, vt, o, lse, q_shape = res
+    b, t, h, d = q_shape
+    scale = d**-0.5
+    bq = min(block_q, max(t, 1))
+    bk = min(block_k, max(t, 1))
+    tq_pad = qt.shape[2]
+    tk_pad = kt.shape[2]
+    n_q = tq_pad // bq
+    n_k = tk_pad // bk
+
+    do = _pad_to(_to_bhtd(g), tq_pad, 2)
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise precompute, plain XLA.
+    delta = jnp.sum(
+        do[:, :, :, :].astype(jnp.float32) * _pad_to(o, tq_pad, 2).astype(jnp.float32),
+        axis=-1,
+    )[:, :, None, :]  # [B, H, 1, Tq_pad]
+    lse_p = _pad_to(lse, tq_pad, 3)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, block_k=bk, seq_len=t, causal=causal
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 1, bq), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq_pad, d), qt.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, do, lse_p, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, block_q=bq, seq_len=t, causal=causal
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, tq_pad), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, tq_pad), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tk_pad, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, tk_pad, d), vt.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, do, lse_p, delta)
+
+    return (
+        _from_bhtd(dq[:, :, :t, :]),
+        _from_bhtd(dk[:, :, :t, :]),
+        _from_bhtd(dv[:, :, :t, :]),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_k: int = _DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused flash attention on ``[B, T, H, D]`` tensors.
+
+    Numerics match ``models.vit.dot_product_attention`` (softmax statistics in
+    float32, scale ``D**-0.5``); memory is O(T) per (batch, head) instead of
+    the O(T^2) score tensor. ``interpret=None`` auto-selects: compiled on TPU,
+    Pallas interpreter elsewhere (slow — tests only).
+    """
+    if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"expected matching [B,T,H,D] q/k/v, got {q.shape}/{k.shape}/{v.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+# Below this sequence length the plain O(T^2) XLA path wins: the score tensor
+# is small enough to live in VMEM-friendly fusions, while the kernel pays
+# layout transposes + block padding. Measured on v5e (fwd+bwd, bf16, D=64):
+# T=197 (ViT-B) 0.65x, T=256 1.25x, T=1024 1.25x, T=8192 4.4x (and the plain
+# path OOMs outright at T=8192 beyond batch 1 — 12GB score tensors).
+FLASH_MIN_SEQ_LEN = 512
+
+
+def make_attention_fn(causal: bool = False, min_seq_len: int = FLASH_MIN_SEQ_LEN, **kwargs):
+    """Adapter for ``MultiHeadAttention(attention_fn=...)`` (models/vit.py).
+
+    Shape-aware: dispatches to the flash kernel when the (static) sequence
+    length is long enough for it to beat XLA's fused softmax-attention, and to
+    the plain path otherwise — the per-config choice is made once at trace
+    time, so the compiled step contains exactly one implementation.
+    """
+
+    def attention_fn(q, k, v):
+        if q.shape[1] < min_seq_len:
+            from distributed_training_pytorch_tpu.models.vit import dot_product_attention
+
+            return _causal_plain(q, k, v) if causal else dot_product_attention(q, k, v, dtype=q.dtype)
+        return flash_attention(q, k, v, causal=causal, **kwargs)
+
+    return attention_fn
+
+
+def _causal_plain(q, k, v):
+    t = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    idx = jnp.arange(t)
+    logits = jnp.where((idx[:, None] >= idx[None, :])[None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

@@ -127,7 +127,9 @@ static uint8_t* decode_jpeg(FILE* f, int* h, int* w) {
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
-  uint8_t* buf = nullptr;
+  // volatile: modified between setjmp and longjmp, read in the error path —
+  // non-volatile locals are indeterminate there per the C standard.
+  uint8_t* volatile buf = nullptr;
   if (setjmp(jerr.jb)) {
     jpeg_destroy_decompress(&cinfo);
     free(buf);
@@ -155,11 +157,15 @@ static uint8_t* decode_png(FILE* f, int* h, int* w) {
   png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
   if (!png) return nullptr;
   png_infop info = png_create_info_struct(png);
-  uint8_t* buf = nullptr;
-  std::vector<png_bytep> rows;
+  // volatile + malloc (not std::vector): both are modified between setjmp and
+  // longjmp and read in the error path — non-volatile locals are
+  // indeterminate there, and a vector's destructor would run on garbage.
+  uint8_t* volatile buf = nullptr;
+  png_bytep* volatile rows = nullptr;
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     free(buf);
+    free(rows);
     return nullptr;
   }
   png_init_io(png, f);
@@ -178,10 +184,11 @@ static uint8_t* decode_png(FILE* f, int* h, int* w) {
     png_set_strip_alpha(png);
   png_read_update_info(png, info);
   buf = (uint8_t*)malloc((size_t)(*w) * (*h) * 3);
-  rows.resize(*h);
+  rows = (png_bytep*)malloc((size_t)(*h) * sizeof(png_bytep));
   for (int y = 0; y < *h; ++y) rows[y] = buf + (size_t)y * (*w) * 3;
-  png_read_image(png, rows.data());
+  png_read_image(png, rows);
   png_destroy_read_struct(&png, &info, nullptr);
+  free(rows);
   return buf;
 }
 

@@ -23,7 +23,7 @@ offline evaluator — rebuilt TPU-first:
   defaults (``utils.tpu``).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
     setup_distributed,

@@ -76,6 +76,10 @@ class NativeImageFolderSource(ImageFolderDataSource):
     (val/eval) hot path. Falls back to the per-record Python transform path
     inside ``load_batch`` when the native library is unavailable."""
 
+    # formats the csrc decoders handle; anything else (bmp/webp from
+    # _IMAGE_EXTS) falls back to the per-record cv2/PIL path below.
+    _NATIVE_EXTS = (".jpg", ".jpeg", ".png")
+
     def __init__(
         self,
         data_path: str,
@@ -92,20 +96,41 @@ class NativeImageFolderSource(ImageFolderDataSource):
         self.mean = transforms.IMAGENET_MEAN if mean is None else np.asarray(mean, np.float32)
         self.std = transforms.IMAGENET_STD if std is None else np.asarray(std, np.float32)
         self._native = native if native.available() else None
+        # Python fallback must use the SAME mean/std as the native call, or a
+        # mixed jpeg+bmp batch gets inconsistent normalization.
+        self._py_transform = transforms.Compose(
+            [transforms.resize(height, width), transforms.normalize(self.mean, self.std)]
+        )
         if self._native is None:
-            self.transform = transforms.eval_transform(height, width)
+            self.transform = self._py_transform
+
+    def _decode_py(self, index: int) -> np.ndarray:
+        return self._py_transform(super().__getitem__(index)["image"])
 
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         labels = np.array([self.records[int(i)][1] for i in rows], np.int32)
         if self._native is not None:
-            paths = [self.records[int(i)][0] for i in rows]
-            images = self._native.decode_resize_normalize(
-                paths, self.height, self.width, self.mean, self.std
-            )
+            # Partition by POSITION (row indices can repeat under pad_final).
+            native_pos = [
+                p
+                for p, i in enumerate(rows)
+                if self.records[int(i)][0].lower().endswith(self._NATIVE_EXTS)
+            ]
+            images = np.empty((len(rows), self.height, self.width, 3), np.float32)
+            if native_pos:
+                decoded = self._native.decode_resize_normalize(
+                    [self.records[int(rows[p])][0] for p in native_pos],
+                    self.height,
+                    self.width,
+                    self.mean,
+                    self.std,
+                )
+                images[native_pos] = decoded
+            fallback = set(range(len(rows))) - set(native_pos)
+            for p in fallback:
+                images[p] = self._decode_py(int(rows[p]))
         else:
-            images = np.stack(
-                [self.transform(super().__getitem__(int(i))["image"]) for i in rows]
-            )
+            images = np.stack([self._decode_py(int(i)) for i in rows])
         return {"image": images, "label": labels}
 
 

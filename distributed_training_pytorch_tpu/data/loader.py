@@ -88,7 +88,9 @@ class ShardedLoader:
         n = len(self.source)
         if self.shuffle:
             rng = np.random.Generator(
-                np.random.Philox(key=transforms.philox_key(self.seed, self._epoch, 0))
+                np.random.Philox(
+                    key=transforms.philox_key(self.seed, self._epoch, transforms.SHUFFLE_INDEX)
+                )
             )
             return rng.permutation(n)
         return np.arange(n)

@@ -41,7 +41,16 @@ def _load():
         if _lib is not None or _build_failed:
             return _lib
         path = os.path.join(_LIB_DIR, _LIB_NAME)
-        if not os.path.exists(path) and os.path.isdir(_CSRC):
+        # (Re)build when the library is missing OR stale — an existing .so
+        # older than any csrc source must not silently shadow edited code.
+        needs_build = not os.path.exists(path)
+        if not needs_build and os.path.isdir(_CSRC):
+            src_mtime = max(
+                (os.path.getmtime(os.path.join(_CSRC, f)) for f in os.listdir(_CSRC)),
+                default=0.0,
+            )
+            needs_build = src_mtime > os.path.getmtime(path)
+        if needs_build and os.path.isdir(_CSRC):
             try:
                 subprocess.run(
                     ["make", "-C", _CSRC],
@@ -50,8 +59,16 @@ def _load():
                     timeout=120,
                 )
             except (subprocess.SubprocessError, OSError):
-                _build_failed = True
-                return None
+                if not os.path.exists(path):
+                    _build_failed = True
+                    return None
+                # stale library + failed rebuild: better than nothing, but loud
+                import warnings
+
+                warnings.warn(
+                    f"{_LIB_NAME} is older than csrc sources and rebuilding "
+                    "failed; using the stale library"
+                )
         if not os.path.exists(path):
             _build_failed = True
             return None

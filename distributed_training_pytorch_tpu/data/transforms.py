@@ -32,9 +32,16 @@ Transform = Callable[[np.ndarray, np.random.Generator], np.ndarray]
 
 def philox_key(seed: int, epoch: int, index: int) -> np.ndarray:
     """Pack (seed, epoch, index) into Philox's 2x64-bit key (epoch in the top
-    24 bits of word 1, index below — supports 2^40 records per epoch)."""
+    24 bits of word 1, index below — supports 2^40-1 records per epoch; the
+    top index value is reserved, see ``SHUFFLE_INDEX``)."""
     word1 = (np.uint64(epoch) << np.uint64(40)) | np.uint64(index)
     return np.array([np.uint64(seed), word1], dtype=np.uint64)
+
+
+# Reserved record-index for the loader's epoch-shuffle stream: domain-separates
+# the permutation draws from every per-record augmentation stream (record 0's
+# key would otherwise equal the shuffle key for the same (seed, epoch)).
+SHUFFLE_INDEX = (1 << 40) - 1
 
 
 def _cv2():

@@ -341,6 +341,7 @@ class Trainer:
         metrics (pad-mask aware). Twin of ``trainer/trainer.py:184-206``."""
         sums: dict[str, Any] = {}
         weight_total = 0.0
+        mask_contract_checked = False
         for b, host_batch in enumerate(self.val_dataloader):
             host_batch = self.preprocess_batch(host_batch)
             # Weight by the batch's GLOBAL real-row count — identical on every
@@ -350,6 +351,27 @@ class Trainer:
                 weight = float(self.val_dataloader.global_real_count(b))
             else:
                 weight = float(len(next(iter(host_batch.values()))))
+            # Contract check (once, on the first batch that actually contains
+            # padding — global real count below the global batch size):
+            # real-count weighting is only exact when the user's metrics
+            # down-weight padded rows via batch["mask"] (ops.weighted_mean).
+            # A criterion that ignores the mask gets pad-diluted values
+            # silently combined with real-row weights.
+            if (
+                not mask_contract_checked
+                and "mask" in host_batch
+                and weight < float(self.batch_size)
+            ):
+                mask_contract_checked = True
+                if getattr(self, "criterion_uses_mask", None) is not True:
+                    self.log(
+                        "this validation batch is padded (batch['mask']): "
+                        "metrics must down-weight padded rows (ops.weighted_mean) "
+                        "or they are diluted. Set self.criterion_uses_mask = True "
+                        "once your build_criterion handles the mask to silence "
+                        "this.",
+                        "warning",
+                    )
             batch = self.engine.shard_batch(host_batch)
             metrics = self.validate_step(self.state, batch)
             # Weighted sums accumulate as device scalars; the epoch's single

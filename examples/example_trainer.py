@@ -65,6 +65,10 @@ class ExampleTrainer(Trainer):
         # default initializer.
         return VGG16(num_classes=len(self.labels))
 
+    # mask-weighted metrics below satisfy the padded-validation contract
+    # (trainer.validate warns when this is not declared)
+    criterion_uses_mask = True
+
     def build_criterion(self):
         def criterion(logits, batch):
             mask = batch.get("mask")

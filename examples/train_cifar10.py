@@ -125,6 +125,10 @@ class Cifar10Trainer(Trainer):
             model = InputNormalizer(model, mean=tuple(CIFAR_MEAN), std=tuple(CIFAR_STD))
         return model
 
+    # mask-weighted metrics below satisfy the padded-validation contract
+    # (trainer.validate warns when this is not declared)
+    criterion_uses_mask = True
+
     def build_criterion(self):
         def criterion(logits, batch):
             mask = batch.get("mask")

@@ -5,6 +5,12 @@ from distributed_training_pytorch_tpu.data.dataset import (  # noqa: F401
 )
 from distributed_training_pytorch_tpu.data import native  # noqa: F401
 from distributed_training_pytorch_tpu.data.loader import ShardedLoader  # noqa: F401
+from distributed_training_pytorch_tpu.data.records import (  # noqa: F401
+    RecordFileSource,
+    RecordFileWriter,
+    pack_image_folder,
+    write_shards,
+)
 from distributed_training_pytorch_tpu.data.prefetch import device_prefetch  # noqa: F401
 from distributed_training_pytorch_tpu.data.transforms import (  # noqa: F401
     IMAGENET_MEAN,

@@ -45,8 +45,15 @@ def _load():
         # older than any csrc source must not silently shadow edited code.
         needs_build = not os.path.exists(path)
         if not needs_build and os.path.isdir(_CSRC):
+            # Only files the make target actually depends on — including the
+            # Makefile here would make an edited Makefile trigger a perpetual
+            # no-op `make` (its target depends on the .cpp alone).
             src_mtime = max(
-                (os.path.getmtime(os.path.join(_CSRC, f)) for f in os.listdir(_CSRC)),
+                (
+                    os.path.getmtime(os.path.join(_CSRC, f))
+                    for f in os.listdir(_CSRC)
+                    if f.endswith((".cpp", ".cc", ".h", ".hpp"))
+                ),
                 default=0.0,
             )
             needs_build = src_mtime > os.path.getmtime(path)

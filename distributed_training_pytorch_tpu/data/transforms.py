@@ -58,6 +58,38 @@ def resize(height: int, width: int) -> Transform:
     return apply
 
 
+def random_resized_crop(
+    height: int,
+    width: int,
+    scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3),
+) -> Transform:
+    """Standard ImageNet train crop: sample an area fraction and aspect ratio,
+    crop, resize to (height, width). Falls back to a center crop when 10
+    attempts don't fit (torchvision semantics)."""
+
+    def apply(img, rng):
+        cv2 = _cv2()
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * rng.uniform(*scale)
+            log_r = rng.uniform(np.log(ratio[0]), np.log(ratio[1]))
+            cw = int(round(np.sqrt(target * np.exp(log_r))))
+            ch = int(round(np.sqrt(target / np.exp(log_r))))
+            if 0 < cw <= w and 0 < ch <= h:
+                y0 = int(rng.integers(0, h - ch + 1))
+                x0 = int(rng.integers(0, w - cw + 1))
+                crop = img[y0 : y0 + ch, x0 : x0 + cw]
+                return cv2.resize(crop, (width, height), interpolation=cv2.INTER_LINEAR)
+        side = min(h, w)
+        y0, x0 = (h - side) // 2, (w - side) // 2
+        crop = img[y0 : y0 + side, x0 : x0 + side]
+        return cv2.resize(crop, (width, height), interpolation=cv2.INTER_LINEAR)
+
+    return apply
+
+
 def random_rotate90(p: float = 0.5) -> Transform:
     def apply(img, rng):
         if rng.random() < p:

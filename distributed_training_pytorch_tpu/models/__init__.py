@@ -24,4 +24,6 @@ def create_model(name: str, num_classes: int, **kwargs):
         return ViTB16(num_classes=num_classes, **kwargs)
     if name in ("convnext-l", "convnext_l", "convnextl", "convnext"):
         return ConvNeXtL(num_classes=num_classes, **kwargs)
+    if name in ("convnext-tiny", "convnext_tiny"):
+        return ConvNeXtTiny(num_classes=num_classes, **kwargs)
     raise ValueError(f"unknown model {name!r}")

@@ -360,7 +360,7 @@ class Trainer:
             if (
                 not mask_contract_checked
                 and "mask" in host_batch
-                and weight < float(self.batch_size)
+                and np.asarray(host_batch["mask"]).min() == 0  # padding present
             ):
                 mask_contract_checked = True
                 if getattr(self, "criterion_uses_mask", None) is not True:

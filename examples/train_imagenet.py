@@ -1,0 +1,186 @@
+"""ImageNet-scale training entry — BASELINE.json configs 3-5.
+
+One entry for the three scale-out configs (the reference has a single config
+in ``main.py:9-22``; these extend its capability surface per BASELINE.md):
+
+=============  ==============================  =========================================
+``MODEL=``     BASELINE config                 recipe
+``resnet50``   3: ResNet-50 / ImageNet-1k      SGD momentum, 5-epoch warmup + cosine
+``vit_b16``    4: ViT-B/16 / ImageNet-1k       AdamW, cosine, patch-embed + MHA
+``convnext_l`` 5: ConvNeXt-L / ImageNet-21k    AdamW, bf16 + gradient accumulation
+=============  ==============================  =========================================
+
+Data comes from sharded record files (``data.records`` — pack a folder tree
+once with ``python -m distributed_training_pytorch_tpu.data.records`` or
+``pack_image_folder``); loose-file ImageFolder scans do not scale to 1.2M+
+images. When ``IMAGENET_RECORDS`` is unset, a synthetic in-memory set with the
+right shapes runs instead, so every config is smoke-runnable anywhere
+(``STEPS_PER_EPOCH`` caps an epoch for timed runs).
+
+Launch: ``MODEL=convnext_l ./run.sh`` (single host) or with the coordinator
+env for pods (see run.sh). Env knobs: ``IMAGENET_RECORDS`` (glob or dir of
+.rec shards), ``VAL_RECORDS``, ``EPOCHS``, ``BATCH`` (global), ``ACCUM``
+(grad-accum microsteps; default 4 for convnext_l else 1), ``BASE_LR``,
+``IMAGE_SIZE`` (default 224), ``NUM_CLASSES`` (default 1000; 21841 for
+convnext_l), ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_training_pytorch_tpu.data import ArrayDataSource, RecordFileSource
+from distributed_training_pytorch_tpu.data import transforms as T
+from distributed_training_pytorch_tpu.models import create_model
+from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, warmup_cosine_lr
+from distributed_training_pytorch_tpu.trainer import Trainer
+from distributed_training_pytorch_tpu.utils import Logger
+from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
+
+RECIPES = {
+    "resnet50": dict(num_classes=1000, optimizer="sgd", base_lr=0.1, accum=1, wd=1e-4),
+    "vit_b16": dict(num_classes=1000, optimizer="adamw", base_lr=1e-3, accum=1, wd=0.05),
+    "convnext_l": dict(num_classes=21841, optimizer="adamw", base_lr=1e-3, accum=4, wd=0.05),
+    # CPU-smokeable stand-in for the convnext_l recipe (same optimizer/accum
+    # path; ConvNeXt-L itself takes too long to compile on a CPU host).
+    "convnext_tiny": dict(num_classes=21841, optimizer="adamw", base_lr=1e-3, accum=4, wd=0.05),
+}
+
+
+def train_transform(image_size: int, seed: int) -> T.Compose:
+    """Random-resized-crop + flip + normalize, Philox-keyed per (epoch, index)
+    — the at-scale analog of the reference's albumentations pipeline
+    (``dataset/example_dataset.py:35-46``)."""
+    return T.Compose(
+        [
+            T.random_resized_crop(image_size, image_size),
+            T.horizontal_flip(),
+            T.normalize(),
+        ],
+        seed=seed,
+    )
+
+
+def eval_transform(image_size: int) -> T.Compose:
+    return T.eval_transform(image_size, image_size)
+
+
+def synthetic_source(n: int, image_size: int, num_classes: int, transform, seed: int):
+    """Class-separable synthetic images, uint8 — shapes/dtypes of the real
+    pipeline without the corpus."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, num_classes, size=(n,)).astype(np.int32)
+    x = (rng.randn(n, image_size, image_size, 3) * 40 + 110 + (y % 13)[:, None, None, None] * 9)
+    return ArrayDataSource(transform=transform, image=x.clip(0, 255).astype(np.uint8), label=y)
+
+
+class _LimitedSource:
+    """Length-capping view over a source — ``STEPS_PER_EPOCH`` for timed runs
+    without touching the underlying corpus."""
+
+    def __init__(self, source, max_records: int):
+        self.source = source
+        self.transform = getattr(source, "transform", None)
+        self._len = min(len(source), max_records)
+
+    def __len__(self):
+        return self._len
+
+    def __getitem__(self, index):
+        return self.source[index]
+
+
+class ImageNetTrainer(Trainer):
+    criterion_uses_mask = True
+
+    def __init__(self, model_name: str, image_size: int, base_lr: float, **kw):
+        self.model_name = model_name
+        self.image_size = image_size
+        self.base_lr = base_lr
+        self.recipe = RECIPES[model_name]
+        self.num_classes = int(os.environ.get("NUM_CLASSES", self.recipe["num_classes"]))
+        self.train_records = os.environ.get("IMAGENET_RECORDS")
+        self.val_records = os.environ.get("VAL_RECORDS")
+        super().__init__(**kw)
+
+    def build_train_dataset(self):
+        tfm = train_transform(self.image_size, seed=self.seed)
+        if self.train_records:
+            source = RecordFileSource(self.train_records, transform=tfm)
+        else:
+            self.log("IMAGENET_RECORDS unset — synthetic ImageNet-shaped data", "warning")
+            source = synthetic_source(8192, self.image_size, self.num_classes, tfm, seed=0)
+        cap = os.environ.get("STEPS_PER_EPOCH")
+        if cap:
+            source = _LimitedSource(source, int(cap) * self.batch_size)
+        return source
+
+    def build_val_dataset(self):
+        tfm = eval_transform(self.image_size)
+        if self.val_records:
+            return RecordFileSource(self.val_records, transform=tfm)
+        return synthetic_source(1024, self.image_size, self.num_classes, tfm, seed=1)
+
+    def build_model(self):
+        return create_model(self.model_name, num_classes=self.num_classes, dtype=jnp.bfloat16)
+
+    def build_criterion(self):
+        def criterion(logits, batch):
+            mask = batch.get("mask")
+            loss = cross_entropy_loss(logits, batch["label"], weights=mask)
+            return loss, {
+                "ce_loss": loss,
+                "accuracy": accuracy(logits, batch["label"], weights=mask),
+            }
+
+        return criterion
+
+    def build_scheduler(self):
+        steps_per_epoch = max(1, len(self.train_dataset) // self.batch_size)
+        if self.recipe["optimizer"] == "sgd":
+            lr = self.base_lr * self.batch_size / 256.0  # Goyal et al. scaling
+        else:
+            lr = self.base_lr * self.batch_size / 4096.0  # AdamW convention
+        return warmup_cosine_lr(lr, self.max_epoch, steps_per_epoch, warmup_epochs=5)
+
+    def build_optimizer(self, schedule):
+        if self.recipe["optimizer"] == "sgd":
+            return optax.chain(
+                optax.add_decayed_weights(self.recipe["wd"]),
+                optax.sgd(schedule, momentum=0.9),
+            )
+        return optax.adamw(schedule, weight_decay=self.recipe["wd"], b1=0.9, b2=0.999)
+
+
+if __name__ == "__main__":
+    enable_fast_rng()
+    Trainer.distributed_setup()
+    model_name = os.environ.get("MODEL", "resnet50").lower()
+    if model_name not in RECIPES:
+        raise SystemExit(f"MODEL={model_name!r}: choose from {sorted(RECIPES)}")
+    recipe = RECIPES[model_name]
+    save_dir = os.environ.get("SAVE_DIR", f"./runs/{model_name}")
+    trainer = ImageNetTrainer(
+        model_name=model_name,
+        image_size=int(os.environ.get("IMAGE_SIZE", "224")),
+        base_lr=float(os.environ.get("BASE_LR", str(recipe["base_lr"]))),
+        max_epoch=int(os.environ.get("EPOCHS", "90")),
+        batch_size=int(os.environ.get("BATCH", "1024")),
+        accum_steps=int(os.environ.get("ACCUM", str(recipe["accum"]))),
+        have_validate=True,
+        save_best_for=("accuracy", "geq"),
+        save_period=1,
+        save_folder=save_dir,
+        snapshot_path=os.environ.get("SNAPSHOT") or None,
+        logger=Logger(f"imagenet-{model_name}", os.path.join(save_dir, "logfile.log")),
+        profile_dir=os.environ.get("PROFILE_DIR") or None,
+    )
+    trainer.train()
+    Trainer.destroy_process()

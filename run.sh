@@ -16,5 +16,13 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-# North-star config (BASELINE.md): VGG16 / CIFAR-10, bf16, DP over all chips.
-exec python examples/train_cifar10.py "$@"
+# MODEL selects the BASELINE config:
+#   (unset) / vgg16  -> config 1-2: VGG16 / CIFAR-10 (the north star)
+#   resnet50         -> config 3:   ResNet-50 / ImageNet-1k
+#   vit_b16          -> config 4:   ViT-B/16  / ImageNet-1k
+#   convnext_l       -> config 5:   ConvNeXt-L / ImageNet-21k (bf16 + grad-accum)
+MODEL="${MODEL:-vgg16}"
+if [ "$MODEL" = "vgg16" ]; then
+  exec python examples/train_cifar10.py "$@"
+fi
+exec python examples/train_imagenet.py "$@"

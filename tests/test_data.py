@@ -187,3 +187,19 @@ def test_device_prefetch_propagates_errors(devices):
     next(it)
     with pytest.raises(RuntimeError, match="decode failed"):
         list(it)
+
+
+def test_random_resized_crop_deterministic():
+    """Same (seed, epoch, index) -> identical crop; output shape fixed; crop
+    content comes from the source image."""
+    from distributed_training_pytorch_tpu.data import transforms as T
+
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 255, size=(40, 60, 3), dtype=np.uint8)
+    tfm = T.Compose([T.random_resized_crop(16, 16)], seed=7)
+    a = tfm(img, epoch=2, index=5)
+    b = tfm(img, epoch=2, index=5)
+    np.testing.assert_array_equal(a, b)
+    c = tfm(img, epoch=2, index=6)
+    assert a.shape == c.shape == (16, 16, 3)
+    assert not np.array_equal(a, c)  # different record -> different crop

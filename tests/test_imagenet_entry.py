@@ -1,0 +1,57 @@
+"""examples/train_imagenet.py entry — recipe wiring + slow end-to-end smoke.
+
+The full model compiles are minutes on CPU, so only the wiring tests run by
+default; the end-to-end pass is ``-m slow`` (the CI/driver runs it on TPU
+implicitly via ``MODEL=... ./run.sh``).
+"""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+
+def _load_module():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "examples"))
+    try:
+        import importlib
+
+        return importlib.import_module("train_imagenet")
+    finally:
+        sys.path.pop(0)
+
+
+def test_recipes_resolve_models():
+    """Every RECIPES entry must build through the model-zoo factory."""
+    from distributed_training_pytorch_tpu.models import create_model
+
+    mod = _load_module()
+    for name, recipe in mod.RECIPES.items():
+        model = create_model(name, num_classes=5)
+        assert model is not None, name
+        assert recipe["accum"] >= 1 and recipe["optimizer"] in ("sgd", "adamw")
+
+
+def test_limited_source_caps_length():
+    mod = _load_module()
+    src = mod.synthetic_source(100, 16, 5, None, seed=0)
+    capped = mod._LimitedSource(src, 24)
+    assert len(capped) == 24
+    assert capped[3]["image"].shape == (16, 16, 3)
+
+
+@pytest.mark.slow
+def test_end_to_end_resnet50_synthetic(tmp_path, monkeypatch):
+    monkeypatch.setenv("MODEL", "resnet50")
+    monkeypatch.setenv("EPOCHS", "1")
+    monkeypatch.setenv("BATCH", "16")
+    monkeypatch.setenv("IMAGE_SIZE", "64")
+    monkeypatch.setenv("NUM_CLASSES", "5")
+    monkeypatch.setenv("STEPS_PER_EPOCH", "2")
+    monkeypatch.setenv("SAVE_DIR", str(tmp_path))
+    monkeypatch.delenv("IMAGENET_RECORDS", raising=False)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runpy.run_path(os.path.join(repo, "examples", "train_imagenet.py"), run_name="__main__")
+    assert (tmp_path / "weights").exists()

@@ -135,7 +135,9 @@ def main():
     cfg = BENCH_MODELS[model_name]
     batch = int(os.environ.get("BENCH_BATCH", str(cfg["batch"])))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
-    windows = int(os.environ.get("BENCH_WINDOWS", "4"))
+    # Several short windows spread over ~1 min: the shared chip's slow phases
+    # last tens of seconds, and best-of-windows should sample past them.
+    windows = int(os.environ.get("BENCH_WINDOWS", "6"))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", str(cfg["image_size"])))
     num_classes = cfg["num_classes"]
 
@@ -178,7 +180,9 @@ def main():
     state, m = compiled(state, gbatch)
     _ = float(m["loss"])
     per_step = []
-    for _ in range(windows):
+    for w in range(windows):
+        if w:
+            time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = compiled(state, gbatch)

@@ -94,16 +94,27 @@ class TrainEngine:
         # first use (init_state or the first step); replicated for pure DP,
         # rule/FSDP-sharded otherwise (parallel.sharding).
         self._state_sharding = None
+        self._state_structure = None
         self._train_step = None
         self._eval_step = None
 
     def state_sharding(self, state_or_abstract) -> Any:
         """The NamedSharding tree this engine lays state out with.
 
-        Contract: one engine serves ONE state structure — the tree is computed
-        from the first state seen (init_state or the first step) and cached;
-        later calls return that cached tree regardless of argument."""
+        Contract: one engine serves ONE state — the tree is computed from the
+        first state seen (init_state or the first step), cached, and later
+        calls must present the same tree structure AND leaf shapes/dtypes (a
+        second model/state on a reused engine would otherwise silently get the
+        first one's shardings: at best a cryptic XLA error, at worst wrong
+        layouts)."""
+        # str(dtype) rather than result_type: typed PRNG-key leaves carry an
+        # extended dtype that result_type rejects.
+        leaf_shapes = jax.tree.map(
+            lambda x: (tuple(x.shape), str(getattr(x, "dtype", None))), state_or_abstract
+        )
+        structure = (jax.tree.structure(state_or_abstract), tuple(jax.tree.leaves(leaf_shapes)))
         if self._state_sharding is None:
+            self._state_structure = structure
             if self.sharding_rules is None and not any(
                 self.mesh.shape.get(a, 1) > 1 for a in (mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS)
             ):
@@ -115,6 +126,12 @@ class TrainEngine:
                     self.sharding_rules or (),
                     fsdp_min_size=self.fsdp_min_size,
                 )
+        elif structure != self._state_structure:
+            raise ValueError(
+                "this TrainEngine is already bound to a state with a "
+                "different structure or leaf shapes/dtypes (one engine serves "
+                "one model/state); build a new engine for the new state."
+            )
         return self._state_sharding
 
     def _build_steps(self, state) -> None:

@@ -44,6 +44,7 @@ Hook mapping (reference -> here):
 from __future__ import annotations
 
 import os
+import signal
 import time
 from typing import Any, Mapping
 
@@ -92,6 +93,7 @@ class Trainer:
         profile_dir: str | None = None,
         profile_steps: int = 5,
         progress: bool = True,
+        save_on_preemption: bool = True,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -119,6 +121,25 @@ class Trainer:
         self.profile_steps = profile_steps
         self._profiled = False
         self.progress = progress
+        # Preemption-aware checkpointing (SURVEY.md §5.3's named upgrade over
+        # the reference's manual-restart-only recovery): SIGTERM — what cloud
+        # schedulers send ahead of eviction, delivered to every host of the
+        # job — sets a flag the epoch loop polls; the loop then saves a
+        # resumable snapshot and returns cleanly. The handler itself only
+        # flips the flag (checkpoint saves are collective and must not run in
+        # signal context).
+        self._preempted = False
+        self._epoch_interrupted = False
+        self._prev_sigterm = None
+        self._sigterm_installed = False
+        if save_on_preemption:
+            try:
+                self._prev_sigterm = signal.signal(
+                    signal.SIGTERM, self._on_preemption_signal
+                )
+                self._sigterm_installed = True
+            except ValueError:
+                pass  # not the main thread (e.g. trainer built in a worker)
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
@@ -214,6 +235,14 @@ class Trainer:
 
     def train(self) -> None:
         """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
+        try:
+            self._train_loop()
+        finally:
+            # Stop owning the process SIGTERM once training is over (or died):
+            # a lingering handler would silently swallow later terminations.
+            self._restore_sigterm()
+
+    def _train_loop(self) -> None:
         best_banner: dict | None = None
         for epoch in range(self.cur_epoch, self.max_epoch):
             self.cur_epoch = epoch
@@ -239,6 +268,23 @@ class Trainer:
                 f"[process {jax.process_index()}] Epoch {epoch + 1}/{self.max_epoch}"
             )
             epoch_metrics = self.train_epoch(epoch)
+
+            # Preemption: save a resumable snapshot and stop. An interrupted
+            # epoch is labeled `epoch` (resume retrains it); a completed one
+            # `epoch + 1` — same labeling rule as the normal saves below.
+            # The decision is collective: a host whose signal arrived after
+            # the last in-epoch poll must not diverge from its peers here.
+            if self._collective_preempt_flag():
+                self._preempted = True
+                resume_epoch = epoch if self._epoch_interrupted else epoch + 1
+                self.checkpoints.save(LAST, self.state, resume_epoch)
+                self.checkpoints.wait()
+                self.log(
+                    f"SIGTERM received — saved resumable snapshot (epoch "
+                    f"{resume_epoch}) to {self.checkpoints.path(LAST)}; exiting",
+                    "warning",
+                )
+                return
 
             # Next-LR report (``:159-160``) — optax schedules are per-step.
             next_lr = float(self.schedule(self.state.step))
@@ -276,7 +322,12 @@ class Trainer:
             (self.preprocess_batch(b) for b in self.train_dataloader), self.mesh
         )
         bar = self._progress_bar(len(self.train_dataloader), f"epoch {epoch + 1}")
+        self._epoch_interrupted = False
         for batch in batches:
+            if self._preemption_requested(step_in_epoch):
+                self._preempted = True  # collective decision (multi-host OR)
+                self._epoch_interrupted = True
+                break
             self._maybe_profile(step_in_epoch)
             self.state, metrics = self.train_step(self.state, batch)
             collected.append(metrics)
@@ -306,6 +357,47 @@ class Trainer:
             return {}
         host = jax.device_get(collected)
         return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+
+    def _on_preemption_signal(self, signum, frame) -> None:
+        # Flag only — saves are collective and cannot run in signal context.
+        self._preempted = True
+        # Chain to whatever handler was installed before this trainer, so a
+        # Trainer never swallows someone else's SIGTERM semantics.
+        if callable(self._prev_sigterm):
+            self._prev_sigterm(signum, frame)
+
+    def _restore_sigterm(self) -> None:
+        if self._sigterm_installed:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm or signal.SIG_DFL)
+            except ValueError:
+                pass
+            self._sigterm_installed = False
+
+    def _preemption_requested(self, step_in_epoch: int) -> bool:
+        """Collective preemption decision. Per-host SIGTERM delivery is not
+        synchronized; if each host acted on its local flag alone, hosts could
+        break on different steps — one skipping a collective its peers entered
+        (deadlock inside the eviction grace window). All hosts therefore
+        agree on the OR of their flags, at the same loop points, every
+        ``_PREEMPT_CHECK_EVERY`` steps."""
+        if jax.process_count() > 1 and step_in_epoch % self._PREEMPT_CHECK_EVERY != 0:
+            return False
+        return self._collective_preempt_flag()
+
+    def _collective_preempt_flag(self) -> bool:
+        """OR of every host's local flag — identical answer on all hosts.
+        Must be called at the same program points on every host."""
+        if jax.process_count() == 1:
+            return self._preempted
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._preempted], dtype=np.bool_)
+        )
+        return bool(np.any(flags))
+
+    _PREEMPT_CHECK_EVERY = 20
 
     def _progress_bar(self, total: int, desc: str):
         """Live per-step progress display (reference shows a tqdm bar with live

@@ -18,9 +18,11 @@ from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss
 
 
-def small_state(devices):
+def _small_state(devices, seed=0):
     mesh = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: len(devices)}, devices=devices)
-    model = VGG16(num_classes=3, stage_features=(4, 8), stage_layers=(1, 1))
+    model = VGG16(
+        num_classes=3, stage_features=(4, 8), stage_layers=(1, 1), classifier_widths=(16,)
+    )
 
     def criterion(logits, batch):
         loss = cross_entropy_loss(logits, batch["label"])
@@ -30,19 +32,28 @@ def small_state(devices):
         make_supervised_loss(model, criterion), optax.sgd(0.01, momentum=0.9), mesh
     )
     state = engine.init_state(
-        jax.random.key(0), lambda rng: model.init(rng, jnp.zeros((1, 16, 16, 3)))
+        jax.random.key(seed), lambda rng: model.init(rng, jnp.zeros((1, 16, 16, 3)))
     )
     return engine, state
 
 
-def test_round_trip(tmp_path, devices):
-    engine, state = small_state(devices)
+@pytest.fixture(scope="module")
+def shared(devices):
+    """(engine, state, differently-seeded state) built once — each init pays a
+    multi-second jit compile on the CPU test platform. Managers only read the
+    states (saves copy, restores return new pytrees), so sharing is safe."""
+    engine, state = _small_state(devices, seed=0)
+    _, other = _small_state(devices, seed=1)
+    return engine, state, other
+
+
+def test_round_trip(tmp_path, shared):
+    engine, state, other = shared
     mgr = CheckpointManager(tmp_path / "ckpt", async_save=False)
     mgr.save(LAST, state, epoch=7)
     assert mgr.exists(LAST)
 
     # Restore into a differently-seeded state; values must match the saved one.
-    _, other = small_state(devices)
     restored, epoch = mgr.restore(LAST, other)
     assert epoch == 7
     leaves_a = jax.tree.leaves(state.params)
@@ -55,8 +66,8 @@ def test_round_trip(tmp_path, devices):
     mgr.close()
 
 
-def test_best_policy_geq(tmp_path, devices):
-    _, state = small_state(devices)
+def test_best_policy_geq(tmp_path, shared):
+    _, state, _ = shared
     mgr = CheckpointManager(
         tmp_path / "ckpt", save_best_for=("accuracy", "geq"), async_save=False
     )
@@ -74,8 +85,8 @@ def test_best_policy_geq(tmp_path, devices):
     mgr.close()
 
 
-def test_best_policy_leq(tmp_path, devices):
-    _, state = small_state(devices)
+def test_best_policy_leq(tmp_path, shared):
+    _, state, _ = shared
     mgr = CheckpointManager(tmp_path / "c", save_best_for=("loss", "leq"), async_save=False)
     assert mgr.maybe_save_best({"loss": 1.0}, state, epoch=0)
     assert not mgr.maybe_save_best({"loss": 2.0}, state, epoch=1)
@@ -83,8 +94,8 @@ def test_best_policy_leq(tmp_path, devices):
     mgr.close()
 
 
-def test_best_value_survives_restore(tmp_path, devices):
-    _, state = small_state(devices)
+def test_best_value_survives_restore(tmp_path, shared):
+    _, state, _ = shared
     mgr = CheckpointManager(tmp_path / "c", save_best_for=("accuracy", "geq"), async_save=False)
     mgr.maybe_save_best({"accuracy": 0.8}, state, epoch=3)
     mgr.close()
@@ -96,8 +107,8 @@ def test_best_value_survives_restore(tmp_path, devices):
     mgr2.close()
 
 
-def test_epoch_name_and_missing(tmp_path, devices):
-    _, state = small_state(devices)
+def test_epoch_name_and_missing(tmp_path, shared):
+    _, state, _ = shared
     assert epoch_checkpoint_name(40) == "checkpoint_epoch_40"
     mgr = CheckpointManager(tmp_path / "c", async_save=False)
     with pytest.raises(FileNotFoundError):
@@ -105,8 +116,8 @@ def test_epoch_name_and_missing(tmp_path, devices):
     mgr.close()
 
 
-def test_async_save_overwrite(tmp_path, devices):
-    engine, state = small_state(devices)
+def test_async_save_overwrite(tmp_path, shared):
+    engine, state, _ = shared
     mgr = CheckpointManager(tmp_path / "c", async_save=True)
     mgr.save(LAST, state, epoch=1)
     mgr.save(LAST, state, epoch=2)  # overwrites; must wait for in-flight save

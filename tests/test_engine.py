@@ -102,3 +102,46 @@ def test_determinism_same_seed(devices):
         state_b, _ = engine_b.train_step(state_b, batch)
     for pa, pb in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_state_sharding_rejects_foreign_state(devices):
+    """Regression (round-1 VERDICT): a reused engine applied the FIRST state's
+    cached sharding tree to any later state; now a different tree structure
+    raises instead of mis-sharding silently."""
+    import pytest
+
+    engine, state = make_engine()
+
+    class OtherMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.Dense(8)(x)
+            x = nn.Dense(16)(x)  # extra layer -> different param tree
+            return nn.Dense(3)(x)
+
+    other = OtherMLP()
+    with pytest.raises(ValueError, match="different structure or leaf shapes"):
+        engine.init_state(
+            jax.random.key(1), lambda rng: other.init(rng, jnp.zeros((1, 4, 4, 3)))
+        )
+
+    class SameTreeDifferentWidth(nn.Module):
+        # same layer count as TinyMLP -> identical tree STRUCTURE, different
+        # leaf shapes; must still be rejected.
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.Dense(64)(x)
+            x = nn.relu(x)
+            return nn.Dense(3)(x)
+
+    widened = SameTreeDifferentWidth()
+    with pytest.raises(ValueError, match="different structure or leaf shapes"):
+        engine.init_state(
+            jax.random.key(2), lambda rng: widened.init(rng, jnp.zeros((1, 4, 4, 3)))
+        )
+    # The original state keeps working.
+    batch = engine.shard_batch(synthetic_batch())
+    state, metrics = engine.train_step(state, batch)
+    assert np.isfinite(float(metrics["ce_loss"]))

@@ -36,13 +36,18 @@ def data_root(tmp_path_factory):
     return root
 
 
-@pytest.fixture
+@pytest.fixture(scope="module")
 def mesh(devices):
     return mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
 
 
 def tiny_vgg(num_classes):
-    return VGG16(num_classes=num_classes, stage_features=(4, 8), stage_layers=(1, 1))
+    return VGG16(
+        num_classes=num_classes,
+        stage_features=(4, 8),
+        stage_layers=(1, 1),
+        classifier_widths=(16,),
+    )
 
 
 def make_example_trainer(data_root, mesh, tmp_path, **kw):
@@ -73,9 +78,18 @@ def make_example_trainer(data_root, mesh, tmp_path, **kw):
     return TinyExampleTrainer(**defaults)
 
 
-def test_example_trainer_end_to_end(data_root, mesh, tmp_path):
-    trainer = make_example_trainer(data_root, mesh, tmp_path)
+@pytest.fixture(scope="module")
+def trained_example(data_root, mesh, tmp_path_factory):
+    """One ExampleTrainer run shared by the end-to-end and offline-eval tests
+    (each extra run costs ~30s of CPU compile/train time)."""
+    tmp_path = tmp_path_factory.mktemp("example")
+    trainer = make_example_trainer(data_root, mesh, tmp_path, progress=False)
     trainer.train()
+    return trainer, tmp_path
+
+
+def test_example_trainer_end_to_end(trained_example, data_root):
+    trainer, _ = trained_example
     assert trainer.checkpoints.exists("best")
     assert trainer.checkpoints.exists("last")
     # val dataset reads val_path (the reference's train_path bug is fixed).
@@ -84,11 +98,10 @@ def test_example_trainer_end_to_end(data_root, mesh, tmp_path):
     assert float(trainer.schedule(0)) == pytest.approx(0.1)
 
 
-def test_offline_eval(data_root, mesh, tmp_path):
+def test_offline_eval(trained_example, data_root, mesh):
     from examples import eval as eval_mod
 
-    trainer = make_example_trainer(data_root, mesh, tmp_path, max_epoch=1, num_workers=0)
-    trainer.train()
+    _, tmp_path = trained_example
     results = eval_mod.evaluate(
         str(tmp_path / "runs" / "weights" / "last"),
         str(data_root / "test"),

@@ -109,10 +109,12 @@ def test_resnet_eval_deterministic(mesh):
     """Eval mode uses running stats — two eval calls agree, and differ from
     train-mode output."""
     model = ResNet18Slim(num_classes=10)
-    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    # jitted: un-jitted op-by-op apply costs ~25s of suite time on CPU.
+    variables = jax.jit(model.init)(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
     x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 32, 3), jnp.float32)
-    e1 = model.apply(variables, x, train=False)
-    e2 = model.apply(variables, x, train=False)
+    eval_fn = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    e1 = eval_fn(variables, x)
+    e2 = eval_fn(variables, x)
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
 
 

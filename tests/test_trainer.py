@@ -1,6 +1,12 @@
 """Trainer orchestration tests: the nine-hook surface, epoch loop, periodic
 validation with best/last checkpointing, and snapshot resume (SURVEY.md §4's
-'overfit a synthetic 3-class set' integration test)."""
+'overfit a synthetic 3-class set' integration test).
+
+Structure note: one module-scoped trained ToyTrainer (``trained``) backs the
+read-only assertions — every extra Trainer construction costs ~15-40s of CPU
+compile/checkpoint time, so tests share the run unless they need their own
+config (resume, periodic-without-validation, preprocess hook).
+"""
 
 import jax.numpy as jnp
 import numpy as np
@@ -36,7 +42,12 @@ class ToyTrainer(Trainer):
         return ArrayDataSource(image=images, label=labels)
 
     def build_model(self):
-        return VGG16(num_classes=3, stage_features=(4, 8), stage_layers=(1, 1))
+        return VGG16(
+            num_classes=3,
+            stage_features=(4, 8),
+            stage_layers=(1, 1),
+            classifier_widths=(16,),  # 4096-wide default heads cost ~40s/test in CPU compile+saves
+        )
 
     def build_criterion(self):
         def criterion(logits, batch):
@@ -56,12 +67,31 @@ class ToyTrainer(Trainer):
         return multistep_lr(0.01, milestones=[50], steps_per_epoch=4)
 
 
-@pytest.fixture
+class RecordingToyTrainer(ToyTrainer):
+    """Keeps per-epoch train metrics so one run serves many assertions."""
+
+    epoch_metrics: list
+
+    def train_epoch(self, epoch):
+        metrics = super().train_epoch(epoch)
+        self.epoch_metrics.append(metrics)
+        return metrics
+
+
+class _CaptureLogger:
+    def __init__(self):
+        self.lines = []
+
+    def log(self, message, log_type="info"):
+        self.lines.append(f"{log_type.upper()}: {message}")
+
+
+@pytest.fixture(scope="module")
 def mesh(devices):
     return mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
 
 
-def make_trainer(tmp_path, mesh, **kw):
+def make_trainer(tmp_path, mesh, cls=ToyTrainer, **kw):
     defaults = dict(
         max_epoch=3,
         batch_size=16,
@@ -73,16 +103,26 @@ def make_trainer(tmp_path, mesh, **kw):
         log_every=0,
         async_checkpoint=False,
         mesh=mesh,
+        progress=False,
     )
     defaults.update(kw)
-    return ToyTrainer(**defaults)
+    return cls(**defaults)
 
 
-def test_full_training_run(tmp_path, mesh, capsys):
-    trainer = make_trainer(tmp_path, mesh)
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory, mesh):
+    """One full 3-epoch training run with validation + best/last saves."""
+    tmp_path = tmp_path_factory.mktemp("trained")
+    logger = _CaptureLogger()
+    trainer = make_trainer(tmp_path, mesh, cls=RecordingToyTrainer, logger=logger)
+    trainer.epoch_metrics = []
     trainer.train()
-    out = capsys.readouterr().out
-    # Loss decreased from epoch 1 to epoch 3 (overfit on separable data).
+    return trainer, logger
+
+
+def test_full_training_run(trained):
+    trainer, logger = trained
+    out = "\n".join(logger.lines)
     assert int(trainer.state.step) == 3 * 4  # 64 records / batch 16 = 4 steps/epoch
     assert trainer.checkpoints.exists(BEST)
     assert trainer.checkpoints.exists(LAST)
@@ -94,25 +134,36 @@ def test_full_training_run(tmp_path, mesh, capsys):
     assert "TOTAL GLOBAL TRAINING LOSS" in out
 
 
-def test_loss_decreases(tmp_path, mesh):
-    trainer = make_trainer(tmp_path, mesh, max_epoch=5, have_validate=False, save_period=10)
-    first = trainer.train_epoch(0)
-    for e in range(1, 5):
-        trainer.train_dataloader.set_epoch(e)
-        last = trainer.train_epoch(e)
-    assert last["ce_loss"] < first["ce_loss"]
+def test_loss_decreases(trained):
+    trainer, _ = trained
+    metrics = trainer.epoch_metrics
+    assert len(metrics) == 3
+    assert metrics[-1]["ce_loss"] < metrics[0]["ce_loss"]
 
 
-def test_resume_from_snapshot(tmp_path, mesh):
-    trainer = make_trainer(tmp_path, mesh, max_epoch=2)
-    trainer.train()
+def test_best_only_improves(trained):
+    trainer, _ = trained
+    assert trainer.checkpoints.best_value is not None
+
+
+def test_validation_is_mask_exact(trained):
+    """24 val records with global batch 16 -> second batch is half padding;
+    accuracy must weight real rows only (impossible to exceed 1.0)."""
+    trainer, _ = trained
+    metrics = trainer.validate()
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    assert np.isfinite(metrics["ce_loss"])
+
+
+def test_resume_from_snapshot(trained, tmp_path, mesh):
+    trainer, _ = trained
     saved_step = int(trainer.state.step)
     last_path = trainer.checkpoints.path(LAST)
 
     resumed = make_trainer(tmp_path, mesh, max_epoch=4, snapshot_path=last_path)
-    assert resumed.cur_epoch == 2, "resume epoch must come from the snapshot"
+    assert resumed.cur_epoch == 3, "resume epoch must come from the snapshot"
     assert int(resumed.state.step) == saved_step
-    resumed.train()  # continues epochs 2..3
+    resumed.train()  # continues epoch 3 only
     assert int(resumed.state.step) == 4 * 4
 
 
@@ -128,22 +179,6 @@ def test_periodic_checkpoint_without_validation(tmp_path, mesh):
     assert not trainer.checkpoints.exists(BEST)
 
 
-def test_validation_is_mask_exact(tmp_path, mesh):
-    """24 val records with global batch 16 -> second batch is half padding;
-    accuracy must weight real rows only (impossible to exceed 1.0)."""
-    trainer = make_trainer(tmp_path, mesh)
-    metrics = trainer.validate()
-    assert 0.0 <= metrics["accuracy"] <= 1.0
-    assert np.isfinite(metrics["ce_loss"])
-
-
-def test_best_only_improves(tmp_path, mesh):
-    trainer = make_trainer(tmp_path, mesh, max_epoch=1)
-    trainer.train()
-    best_after = trainer.checkpoints.best_value
-    assert best_after is not None
-
-
 def test_preprocess_batch_hook(tmp_path, mesh):
     class Scaled(ToyTrainer):
         def preprocess_batch(self, batch):
@@ -151,17 +186,14 @@ def test_preprocess_batch_hook(tmp_path, mesh):
             batch["image"] = batch["image"] * 0.0
             return batch
 
-    trainer = make_trainer(tmp_path, mesh)
-    scaled = Scaled(
+    scaled = make_trainer(
+        tmp_path,
+        mesh,
+        cls=Scaled,
         max_epoch=1,
-        batch_size=16,
         have_validate=False,
+        save_best_for=None,
         save_period=10,
-        save_folder=str(tmp_path / "r2"),
-        num_workers=0,
-        log_every=0,
-        async_checkpoint=False,
-        mesh=mesh,
     )
     m = scaled.train_epoch(0)
     # Zeroed images -> logits identical across classes at init... loss ~ log(3).
@@ -174,3 +206,28 @@ def test_missing_hook_raises(tmp_path, mesh):
 
     with pytest.raises(NotImplementedError):
         Incomplete(max_epoch=1, batch_size=8, save_folder=str(tmp_path), mesh=mesh)
+
+
+def test_preemption_saves_resumable_snapshot(tmp_path, mesh):
+    """SIGTERM (cloud eviction warning) -> the loop saves LAST and returns;
+    the snapshot resumes at the interrupted epoch (SURVEY §5.3 upgrade)."""
+    import os
+    import signal as signal_mod
+
+    trainer = make_trainer(
+        tmp_path, mesh, max_epoch=3, have_validate=False, save_best_for=None, save_period=None
+    )
+    os.kill(os.getpid(), signal_mod.SIGTERM)  # handler flips the flag only
+    trainer.train()
+    assert trainer._preempted
+    assert trainer.checkpoints.exists(LAST)
+    resumed = make_trainer(
+        tmp_path,
+        mesh,
+        max_epoch=3,
+        have_validate=False,
+        save_best_for=None,
+        save_period=None,
+        snapshot_path=trainer.checkpoints.path(LAST),
+    )
+    assert resumed.cur_epoch == 0  # epoch 0 was interrupted -> retrain it

@@ -10,7 +10,8 @@ checkpointing with snapshot resume, file+console logging, and a standalone
 offline evaluator — rebuilt TPU-first:
 
 * ``parallel``  — device-mesh bootstrap (``jax.distributed`` + ``jax.sharding.Mesh``),
-  sharding rules, ring attention / sequence parallelism.
+  sharding rules (FSDP / Megatron-TP), ring + Ulysses sequence parallelism,
+  GPipe-style pipeline parallelism, GShard-style MoE expert parallelism.
 * ``models``    — Flax model zoo (VGG16, ResNet-50, ViT-B/16, ConvNeXt-L).
 * ``ops``       — losses, metrics, schedules, Pallas kernels.
 * ``train``     — functional ``TrainState`` + jitted train/eval step engine

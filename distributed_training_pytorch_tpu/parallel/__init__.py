@@ -19,3 +19,14 @@ from distributed_training_pytorch_tpu.parallel.sharding import (  # noqa: F401
     state_shardings,
     transformer_tp_rules,
 )
+from distributed_training_pytorch_tpu.parallel.pipeline import (  # noqa: F401
+    PIPE_AXIS,
+    pipeline_apply,
+    stack_stage_params,
+)
+from distributed_training_pytorch_tpu.parallel.moe import (  # noqa: F401
+    EXPERT_AXIS,
+    MoEMlp,
+    load_balance_loss,
+    router_z_loss,
+)

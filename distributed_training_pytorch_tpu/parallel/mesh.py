@@ -16,6 +16,8 @@ Mesh axes used throughout the framework:
 * ``fsdp``  — parameter sharding (ZeRO-3 analog), optional.
 * ``tensor``— tensor parallelism for wide layers, optional.
 * ``seq``   — sequence/context parallelism (ring attention), optional.
+* ``pipe``  — pipeline parallelism (``parallel.pipeline``), optional.
+* ``expert``— MoE expert parallelism (``parallel.moe``), optional.
 """
 
 from __future__ import annotations

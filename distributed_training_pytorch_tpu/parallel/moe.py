@@ -1,0 +1,162 @@
+"""Expert parallelism — a GShard-style Mixture-of-Experts FFN layer.
+
+Not in the reference (data parallelism is its only strategy); built to
+complete the parallelism matrix (dp / fsdp / tp / sp / pp / ep) the TPU way:
+no per-expert processes or host-side routing — the layer is ordinary jittable
+einsum algebra over an experts dimension, and *expert parallelism is purely a
+sharding annotation*: stacked expert weights ``[E, ...]`` and the dispatched
+``[E, capacity, d]`` activations carry ``PartitionSpec('expert', ...)``, and
+XLA's SPMD partitioner inserts the all-to-all between the token-sharded and
+expert-sharded layouts (the GShard formulation).
+
+Routing: top-k softmax gating with fixed per-expert capacity. Tokens beyond
+an expert's capacity are dropped for that choice (their other choice and the
+residual path still carry them) — deterministic, order-based priority, first
+choice before second. ``capacity_factor`` sizes the buffers.
+
+Aux losses follow Switch/GShard: ``load_balance_loss`` (mean gate fraction x
+mean dispatch fraction per expert, scaled by E) and ``router_z_loss``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+__all__ = ["EXPERT_AXIS", "MoEMlp", "load_balance_loss", "router_z_loss"]
+
+
+def _constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding constraint that is a no-op outside jit / without a mesh."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def router_z_loss(logits: jax.Array) -> jax.Array:
+    """Encourages small router logits (numerical health; ST-MoE eq. 5)."""
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(z**2)
+
+
+def load_balance_loss(gates: jax.Array, dispatch_mask: jax.Array) -> jax.Array:
+    """Switch-Transformer load-balance loss: E * sum_e f_e * p_e where f_e is
+    the fraction of tokens dispatched to expert e (first choice) and p_e the
+    mean gate probability."""
+    num_experts = gates.shape[-1]
+    f = jnp.mean(dispatch_mask.astype(jnp.float32), axis=0)  # [E]
+    p = jnp.mean(gates.astype(jnp.float32), axis=0)  # [E]
+    return num_experts * jnp.sum(f * p)
+
+
+class MoEMlp(nn.Module):
+    """Mixture-of-experts FFN: ``[..., d] -> [..., d]``.
+
+    Attributes:
+      num_experts: E, ideally a multiple of the mesh's ``expert`` axis size.
+      hidden_dim: per-expert FFN hidden width.
+      top_k: experts per token (1 = Switch, 2 = GShard default).
+      capacity_factor: per-expert buffer = ceil(tokens * top_k / E * factor).
+      dtype: activation dtype (params stay float32).
+
+    Sow'd metrics (``.sow('intermediates', ...)``): ``load_balance_loss`` and
+    ``router_z_loss`` — add them to the training objective via the criterion.
+    """
+
+    num_experts: int
+    hidden_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        tokens = x.reshape(-1, d)  # [S, d]
+        s = tokens.shape[0]
+        e = self.num_experts
+        capacity = max(1, int(np.ceil(s * self.top_k / e * self.capacity_factor)))
+
+        # --- router (float32 for stable softmax) ---------------------------
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )  # [S, E]
+        gates = jax.nn.softmax(logits, axis=-1)
+
+        # --- top-k choice with order-based capacity assignment -------------
+        # Process choices in priority order: choice 0 of every token claims
+        # capacity before any choice 1 (GShard's policy), so dropping is
+        # deterministic and independent of later choices.
+        remaining = gates
+        dispatch = jnp.zeros((s, e, capacity), jnp.bool_)
+        combine = jnp.zeros((s, e, capacity), jnp.float32)
+        used = jnp.zeros((e,), jnp.int32)  # slots claimed so far per expert
+        gate_sum = jnp.zeros((s,), jnp.float32)
+        first_choice_mask = None
+        for _ in range(self.top_k):
+            choice = jnp.argmax(remaining, axis=-1)  # [S]
+            onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [S, E]
+            if first_choice_mask is None:
+                first_choice_mask = onehot
+            # Position of each token within its chosen expert's buffer.
+            pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [S, E]
+            pos = jnp.sum(pos_in_expert * onehot, axis=-1) + used[choice]  # [S]
+            keep = pos < capacity
+            gate = jnp.sum(gates * onehot, axis=-1) * keep  # [S]
+            slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32)
+            contrib = onehot[:, :, None].astype(jnp.float32) * slot[:, None, :]
+            contrib = contrib * keep[:, None, None]
+            dispatch = jnp.logical_or(dispatch, contrib > 0)
+            combine = combine + gate[:, None, None] * contrib
+            gate_sum = gate_sum + gate
+            used = used + jnp.sum(onehot * keep[:, None], axis=0)
+            remaining = remaining * (1.0 - onehot)  # mask the taken expert
+
+        # Renormalize kept gates (standard top-k MoE: weights sum to 1 over
+        # the token's surviving choices).
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+
+        self.sow(
+            "intermediates",
+            "load_balance_loss",
+            load_balance_loss(gates, first_choice_mask),
+        )
+        self.sow("intermediates", "router_z_loss", router_z_loss(logits))
+
+        # --- expert computation (expert-sharded) ---------------------------
+        w_in = self.param(
+            "w_in",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, d, self.hidden_dim),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, self.hidden_dim, d),
+            jnp.float32,
+        )
+        w_in = _constrain(w_in, P(EXPERT_AXIS)).astype(self.dtype)
+        w_out = _constrain(w_out, P(EXPERT_AXIS)).astype(self.dtype)
+
+        # dispatch: [S, E, C] x [S, d] -> [E, C, d]; the resharding from
+        # token-sharded to expert-sharded IS the all-to-all.
+        expert_in = jnp.einsum(
+            "sec,sd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )
+        expert_in = _constrain(expert_in, P(EXPERT_AXIS))
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_in))
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w_out)
+        expert_out = _constrain(expert_out, P(EXPERT_AXIS))
+
+        out = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), expert_out)
+        return out.reshape(orig_shape).astype(self.dtype)

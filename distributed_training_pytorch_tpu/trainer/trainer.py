@@ -132,14 +132,7 @@ class Trainer:
         self._epoch_interrupted = False
         self._prev_sigterm = None
         self._sigterm_installed = False
-        if save_on_preemption:
-            try:
-                self._prev_sigterm = signal.signal(
-                    signal.SIGTERM, self._on_preemption_signal
-                )
-                self._sigterm_installed = True
-            except ValueError:
-                pass  # not the main thread (e.g. trainer built in a worker)
+        self.save_on_preemption = save_on_preemption
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
@@ -235,11 +228,14 @@ class Trainer:
 
     def train(self) -> None:
         """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
+        self._install_sigterm()
         try:
             self._train_loop()
         finally:
             # Stop owning the process SIGTERM once training is over (or died):
             # a lingering handler would silently swallow later terminations.
+            # Symmetric with the install above, so a re-entered train() is
+            # protected again.
             self._restore_sigterm()
 
     def _train_loop(self) -> None:
@@ -366,6 +362,15 @@ class Trainer:
         if callable(self._prev_sigterm):
             self._prev_sigterm(signum, frame)
 
+    def _install_sigterm(self) -> None:
+        if not self.save_on_preemption or self._sigterm_installed:
+            return
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, self._on_preemption_signal)
+            self._sigterm_installed = True
+        except ValueError:
+            pass  # not the main thread (e.g. trainer driven from a worker)
+
     def _restore_sigterm(self) -> None:
         if self._sigterm_installed:
             try:
@@ -378,10 +383,14 @@ class Trainer:
         """Collective preemption decision. Per-host SIGTERM delivery is not
         synchronized; if each host acted on its local flag alone, hosts could
         break on different steps — one skipping a collective its peers entered
-        (deadlock inside the eviction grace window). All hosts therefore
-        agree on the OR of their flags, at the same loop points, every
-        ``_PREEMPT_CHECK_EVERY`` steps."""
-        if jax.process_count() > 1 and step_in_epoch % self._PREEMPT_CHECK_EVERY != 0:
+        (deadlock inside the eviction grace window). All hosts therefore agree
+        on the OR of their flags at the same loop points. To keep "the only
+        intra-epoch host sync is log_every" true, the multi-host vote
+        piggybacks on that cadence (with log_every=0, only epoch boundaries
+        decide); single-process polls its local flag every step for free."""
+        if jax.process_count() == 1:
+            return self._preempted
+        if not self.log_every or step_in_epoch % self.log_every != 0:
             return False
         return self._collective_preempt_flag()
 
@@ -396,8 +405,6 @@ class Trainer:
             np.asarray([self._preempted], dtype=np.bool_)
         )
         return bool(np.any(flags))
-
-    _PREEMPT_CHECK_EVERY = 20
 
     def _progress_bar(self, total: int, desc: str):
         """Live per-step progress display (reference shows a tqdm bar with live

@@ -217,6 +217,9 @@ def test_preemption_saves_resumable_snapshot(tmp_path, mesh):
     trainer = make_trainer(
         tmp_path, mesh, max_epoch=3, have_validate=False, save_best_for=None, save_period=None
     )
+    # The handler installs at train() start; install first so the raw SIGTERM
+    # below flips the trainer flag instead of killing pytest.
+    trainer._install_sigterm()
     os.kill(os.getpid(), signal_mod.SIGTERM)  # handler flips the flag only
     trainer.train()
     assert trainer._preempted

@@ -15,9 +15,10 @@ backward ops, so the analytic number is the headline. Timing is the best of
 retrace; best-of because the shared chip's interference only ever subtracts).
 
 Perf defaults (measured on v5e, see utils/tpu.py): hardware-RBG PRNG for the
-dropout masks (saves ~8% of step time vs threefry) and global batch 4096
+dropout masks (saves ~8% of step time vs threefry), global batch 4096
 (MXU-filling for the FC trio on one chip, +15% over 1024; on multi-chip runs
-raise BENCH_BATCH proportionally — the batch is sharded over the data axis).
+raise BENCH_BATCH proportionally — the batch is sharded over the data axis),
+and a per-compile scoped-VMEM bump (tpu_compiler_options, +9%).
 
 Runs on whatever jax.devices() provides (one real TPU chip under the driver;
 CPU fallback works for smoke-testing with BENCH_STEPS/BENCH_BATCH overrides).
@@ -36,7 +37,7 @@ from distributed_training_pytorch_tpu.models import VGG16
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
-from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
+from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng, tpu_compiler_options
 
 # bf16 peak TFLOP/s per chip, by PJRT device_kind substring.
 PEAK_FLOPS = {
@@ -167,7 +168,10 @@ def main():
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.
-    compiled = engine.compile_train_step(state, gbatch)
+    # tpu_compiler_options: scoped-VMEM bump, measured +9% (utils/tpu.py).
+    compiled = engine.compile_train_step(
+        state, gbatch, compiler_options=tpu_compiler_options()
+    )
     cost = compiled.cost_analysis()
     xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
     step_flops = flops_fn(model, image_size) * batch

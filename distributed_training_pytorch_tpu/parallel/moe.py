@@ -28,17 +28,26 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from distributed_training_pytorch_tpu.parallel.mesh import DATA_AXIS
+
 EXPERT_AXIS = "expert"
 
 __all__ = ["EXPERT_AXIS", "MoEMlp", "load_balance_loss", "router_z_loss"]
 
 
-def _constrain(x: jax.Array, spec: P) -> jax.Array:
-    """Sharding constraint that is a no-op outside jit / without a mesh."""
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
+def _constrain(x: jax.Array, axes: tuple) -> jax.Array:
+    """Constrain dims to mesh axes, skipping axes the ambient mesh lacks.
+
+    No ambient mesh (plain apply outside jit, tests) -> no-op. With a mesh,
+    genuine spec errors (e.g. expert count not divisible by the axis) DO
+    propagate — silently dropping the constraint would run fully replicated
+    while the user believes expert parallelism is active."""
+    mesh = jax.sharding.get_abstract_mesh()
+    mesh_axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    if not mesh_axes:
         return x
+    spec = P(*[a if (a is not None and a in mesh_axes) else None for a in axes])
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def router_z_loss(logits: jax.Array) -> jax.Array:
@@ -64,7 +73,11 @@ class MoEMlp(nn.Module):
       num_experts: E, ideally a multiple of the mesh's ``expert`` axis size.
       hidden_dim: per-expert FFN hidden width.
       top_k: experts per token (1 = Switch, 2 = GShard default).
-      capacity_factor: per-expert buffer = ceil(tokens * top_k / E * factor).
+      capacity_factor: per-expert buffer = ceil(group_tokens * top_k / E * factor).
+      num_groups: routing groups (GShard's G). Dispatch/combine one-hots are
+        O(S^2 * top_k / G); at training scale set this to the data-shard count
+        so each shard routes its own tokens (buffers then shard over ``data``
+        and stay O((S/G)^2)). Capacity is per group. S must divide by G.
       dtype: activation dtype (params stay float32).
 
     Sow'd metrics (``.sow('intermediates', ...)``): ``load_balance_loss`` and
@@ -75,6 +88,7 @@ class MoEMlp(nn.Module):
     hidden_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    num_groups: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -84,7 +98,11 @@ class MoEMlp(nn.Module):
         tokens = x.reshape(-1, d)  # [S, d]
         s = tokens.shape[0]
         e = self.num_experts
-        capacity = max(1, int(np.ceil(s * self.top_k / e * self.capacity_factor)))
+        g = self.num_groups
+        if s % g:
+            raise ValueError(f"{s} tokens not divisible by num_groups={g}")
+        sg = s // g
+        capacity = max(1, int(np.ceil(sg * self.top_k / e * self.capacity_factor)))
 
         # --- router (float32 for stable softmax) ---------------------------
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
@@ -92,43 +110,48 @@ class MoEMlp(nn.Module):
         )  # [S, E]
         gates = jax.nn.softmax(logits, axis=-1)
 
-        # --- top-k choice with order-based capacity assignment -------------
-        # Process choices in priority order: choice 0 of every token claims
-        # capacity before any choice 1 (GShard's policy), so dropping is
-        # deterministic and independent of later choices.
-        remaining = gates
-        dispatch = jnp.zeros((s, e, capacity), jnp.bool_)
-        combine = jnp.zeros((s, e, capacity), jnp.float32)
-        used = jnp.zeros((e,), jnp.int32)  # slots claimed so far per expert
-        gate_sum = jnp.zeros((s,), jnp.float32)
-        first_choice_mask = None
-        for _ in range(self.top_k):
-            choice = jnp.argmax(remaining, axis=-1)  # [S]
-            onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [S, E]
-            if first_choice_mask is None:
-                first_choice_mask = onehot
-            # Position of each token within its chosen expert's buffer.
-            pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [S, E]
-            pos = jnp.sum(pos_in_expert * onehot, axis=-1) + used[choice]  # [S]
-            keep = pos < capacity
-            gate = jnp.sum(gates * onehot, axis=-1) * keep  # [S]
-            slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32)
-            contrib = onehot[:, :, None].astype(jnp.float32) * slot[:, None, :]
-            contrib = contrib * keep[:, None, None]
-            dispatch = jnp.logical_or(dispatch, contrib > 0)
-            combine = combine + gate[:, None, None] * contrib
-            gate_sum = gate_sum + gate
-            used = used + jnp.sum(onehot * keep[:, None], axis=0)
-            remaining = remaining * (1.0 - onehot)  # mask the taken expert
+        # --- per-group top-k routing with order-based capacity --------------
+        # Choices claim capacity in priority order (choice 0 of every token in
+        # the group before any choice 1 — GShard policy) so dropping is
+        # deterministic. Routing is vmapped over groups: one-hot buffers stay
+        # O((S/G)^2) per group and shard over `data` with the groups.
+        def route(group_gates):  # [sg, E] -> dispatch/combine [sg, E, C]
+            remaining = group_gates
+            dispatch = jnp.zeros((sg, e, capacity), jnp.bool_)
+            combine = jnp.zeros((sg, e, capacity), jnp.float32)
+            used = jnp.zeros((e,), jnp.int32)
+            gate_sum = jnp.zeros((sg,), jnp.float32)
+            first_choice = None
+            for _ in range(self.top_k):
+                choice = jnp.argmax(remaining, axis=-1)  # [sg]
+                onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [sg, E]
+                if first_choice is None:
+                    first_choice = onehot
+                pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [sg, E]
+                pos = jnp.sum(pos_in_expert * onehot, axis=-1) + used[choice]
+                keep = pos < capacity
+                gate = jnp.sum(group_gates * onehot, axis=-1) * keep
+                slot = jax.nn.one_hot(
+                    jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32
+                )
+                contrib = onehot[:, :, None].astype(jnp.float32) * slot[:, None, :]
+                contrib = contrib * keep[:, None, None]
+                dispatch = jnp.logical_or(dispatch, contrib > 0)
+                combine = combine + gate[:, None, None] * contrib
+                gate_sum = gate_sum + gate
+                used = used + jnp.sum(onehot * keep[:, None], axis=0)
+                remaining = remaining * (1.0 - onehot)
+            # Renormalize kept gates (weights sum to 1 over surviving choices).
+            combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+            return dispatch, combine, first_choice
 
-        # Renormalize kept gates (standard top-k MoE: weights sum to 1 over
-        # the token's surviving choices).
-        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+        grouped_gates = gates.reshape(g, sg, e)
+        dispatch, combine, first_choice = jax.vmap(route)(grouped_gates)
 
         self.sow(
             "intermediates",
             "load_balance_loss",
-            load_balance_loss(gates, first_choice_mask),
+            load_balance_loss(gates, first_choice.reshape(s, e)),
         )
         self.sow("intermediates", "router_z_loss", router_z_loss(logits))
 
@@ -145,18 +168,20 @@ class MoEMlp(nn.Module):
             (e, self.hidden_dim, d),
             jnp.float32,
         )
-        w_in = _constrain(w_in, P(EXPERT_AXIS)).astype(self.dtype)
-        w_out = _constrain(w_out, P(EXPERT_AXIS)).astype(self.dtype)
+        w_in = _constrain(w_in, (EXPERT_AXIS,)).astype(self.dtype)
+        w_out = _constrain(w_out, (EXPERT_AXIS,)).astype(self.dtype)
 
-        # dispatch: [S, E, C] x [S, d] -> [E, C, d]; the resharding from
-        # token-sharded to expert-sharded IS the all-to-all.
+        # dispatch: [G, sg, E, C] x [G, sg, d] -> [G, E, C, d]; the reshard
+        # from token-sharded [G over data] to expert-sharded IS the all-to-all.
+        grouped_tokens = tokens.reshape(g, sg, d)
+        grouped_tokens = _constrain(grouped_tokens, (DATA_AXIS,))
         expert_in = jnp.einsum(
-            "sec,sd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+            "gsec,gsd->gecd", dispatch.astype(self.dtype), grouped_tokens.astype(self.dtype)
         )
-        expert_in = _constrain(expert_in, P(EXPERT_AXIS))
-        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_in))
-        expert_out = jnp.einsum("ech,ehd->ecd", h, w_out)
-        expert_out = _constrain(expert_out, P(EXPERT_AXIS))
+        expert_in = _constrain(expert_in, (DATA_AXIS, EXPERT_AXIS))
+        h = jax.nn.gelu(jnp.einsum("gecd,edh->gech", expert_in, w_in))
+        expert_out = jnp.einsum("gech,ehd->gecd", h, w_out)
+        expert_out = _constrain(expert_out, (DATA_AXIS, EXPERT_AXIS))
 
-        out = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), expert_out)
+        out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), expert_out)
         return out.reshape(orig_shape).astype(self.dtype)

@@ -109,12 +109,15 @@ def pipeline_apply(
             sent = jax.lax.ppermute(y, axis, perm)
             return (sent, outputs), None
 
-        # pvary: the carry becomes device-varying after one tick (each stage
-        # holds different activations), so the init must carry the same
-        # varying-over-`axis` type or scan rejects the carry signature.
+        # pcast-to-varying: the carry becomes device-varying after one tick
+        # (each stage holds different activations), so the init must carry the
+        # same varying-over-`axis` type or scan rejects the carry signature.
+        def _vary(x):
+            return jax.lax.pcast(x, axis, to="varying")
+
         init = (
-            jax.lax.pvary(jnp.zeros(micro.shape[1:], micro.dtype), axis),
-            jax.lax.pvary(jnp.zeros_like(micro), axis),
+            _vary(jnp.zeros(micro.shape[1:], micro.dtype)),
+            _vary(jnp.zeros_like(micro)),
         )
         (_, outputs), _ = jax.lax.scan(
             tick, init, jnp.arange(n_micro + n_stages - 1)

@@ -271,10 +271,18 @@ class TrainEngine:
         ``parallel.mesh.global_array_from_host_local``)."""
         return mesh_lib.global_array_from_host_local(batch, self.mesh)
 
-    def compile_train_step(self, state: TrainState, batch):
+    def compile_train_step(self, state: TrainState, batch, *, compiler_options=None):
         """AOT-compile the train step for these shapes and return the compiled
         executable (callable as ``compiled(state, batch)``). Supported surface
         for benchmarking: ``compiled.cost_analysis()`` exposes XLA's FLOP
-        estimate for MFU math."""
+        estimate for MFU math.
+
+        ``compiler_options`` passes per-compile XLA flags (e.g.
+        ``{"xla_tpu_scoped_vmem_limit_kib": "49152"}`` — measured ~9% faster
+        on the VGG16/v5e step; see utils/tpu.py) without touching global
+        XLA_FLAGS."""
         self._build_steps(state)
-        return self._train_step.lower(state, batch).compile()
+        lowered = self._train_step.lower(state, batch)
+        if compiler_options:
+            return lowered.compile(compiler_options=dict(compiler_options))
+        return lowered.compile()

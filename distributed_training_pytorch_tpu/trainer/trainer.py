@@ -94,6 +94,7 @@ class Trainer:
         profile_steps: int = 5,
         progress: bool = True,
         save_on_preemption: bool = True,
+        preemption_check_every: int = 20,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -133,6 +134,10 @@ class Trainer:
         self._prev_sigterm = None
         self._sigterm_installed = False
         self.save_on_preemption = save_on_preemption
+        # Multi-host SIGTERM reaction latency bound: every `preemption_check_
+        # every` steps all hosts vote (one tiny allgather — the only intra-
+        # epoch host sync besides log_every). 0 = epoch boundaries only.
+        self.preemption_check_every = preemption_check_every
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
@@ -334,7 +339,8 @@ class Trainer:
                 # the reference's per-step loss.item() sync back in).
                 bar.update(1)
             if self.log_every and step_in_epoch % self.log_every == 0:
-                # The only intra-epoch host sync, every log_every steps.
+                # Intra-epoch host syncs: this (every log_every steps) and,
+                # multi-host only, the preemption vote (_preemption_requested).
                 m = {k: float(v) for k, v in collected[-1].items()}
                 rate = step_in_epoch * self.batch_size / (time.perf_counter() - t0)
                 if bar is not None:
@@ -384,13 +390,15 @@ class Trainer:
         synchronized; if each host acted on its local flag alone, hosts could
         break on different steps — one skipping a collective its peers entered
         (deadlock inside the eviction grace window). All hosts therefore agree
-        on the OR of their flags at the same loop points. To keep "the only
-        intra-epoch host sync is log_every" true, the multi-host vote
-        piggybacks on that cadence (with log_every=0, only epoch boundaries
-        decide); single-process polls its local flag every step for free."""
+        on the OR of their flags at the same loop points, every
+        ``preemption_check_every`` steps — a bounded reaction latency
+        independent of ``log_every`` (an ImageNet epoch is far longer than an
+        eviction grace window, so epoch-boundary-only checking is not enough).
+        Single-process polls its local flag every step for free."""
         if jax.process_count() == 1:
             return self._preempted
-        if not self.log_every or step_in_epoch % self.log_every != 0:
+        cadence = self.preemption_check_every
+        if not cadence or step_in_epoch % cadence != 0:
             return False
         return self._collective_preempt_flag()
 

@@ -11,6 +11,12 @@ from distributed_training_pytorch_tpu.models.convnext import (  # noqa: F401
     ConvNeXtTiny,
 )
 from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer  # noqa: F401
+from distributed_training_pytorch_tpu.models.transformer_lm import (  # noqa: F401
+    DecoderBlock,
+    GPTSmall,
+    LMTiny,
+    TransformerLM,
+)
 
 
 def create_model(name: str, num_classes: int, **kwargs):

@@ -1,0 +1,191 @@
+"""Decoder-only transformer LM — the long-context showcase model family.
+
+Beyond the reference's scope (its only model is VGG16, ``model/vgg16.py``);
+this family exists so the framework's long-context and distributed machinery
+has a first-class consumer, wired end-to-end:
+
+* causal attention via the Pallas flash kernel (``ops.pallas``, auto on TPU
+  for long sequences), ring attention (``parallel.ring_attention``) when the
+  sequence is sharded over a ``seq`` mesh axis, or plain XLA attention;
+* homogeneous pre-LN blocks — exactly the stacked-stage shape
+  ``parallel.pipeline.pipeline_apply`` consumes for pipeline parallelism;
+* optional Mixture-of-Experts FFNs (``parallel.moe.MoEMlp``) every
+  ``moe_every``-th block for expert parallelism;
+* bf16 activation knob with float32 params/logits, like the vision zoo.
+
+Attention selection (``attention_impl``): ``"auto"`` = shape-aware flash on
+TPU / plain elsewhere; ``"flash"`` = force the kernel; ``"plain"`` = XLA
+softmax attention; ``"ring"`` = exact ring attention over the ``seq`` axis of
+the ambient mesh (pass ``mesh=``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.parallel.moe import MoEMlp
+
+
+def _causal_attention_fn(attention_impl: str, mesh):
+    """Resolve ``attention_impl`` to a (q, k, v) -> out callable at apply time
+    (lazily, so constructing a model never initializes jax backends)."""
+    if attention_impl == "ring":
+        if mesh is None:
+            raise ValueError('attention_impl="ring" needs mesh=')
+        from distributed_training_pytorch_tpu.parallel.ring_attention import ring_attention
+
+        return lambda q, k, v: ring_attention(q, k, v, mesh, causal=True)
+    if attention_impl in ("auto", "flash"):
+        from distributed_training_pytorch_tpu.ops.pallas import make_attention_fn
+
+        if attention_impl == "flash":
+            return make_attention_fn(causal=True, min_seq_len=1)
+        if jax.default_backend() == "tpu":
+            return make_attention_fn(causal=True)
+    if attention_impl in ("auto", "plain"):
+        from distributed_training_pytorch_tpu.ops.pallas import _causal_plain
+
+        return _causal_plain
+    raise ValueError(f"unknown attention_impl {attention_impl!r}")
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN decoder block: x + attn(ln(x)); x + ffn(ln(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+    mesh: Any = None
+    use_moe: bool = False
+    num_experts: int = 8
+    moe_num_groups: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        dim = x.shape[-1]
+        head_dim = dim // self.num_heads
+        attn_fn = _causal_attention_fn(self.attention_impl, self.mesh)
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
+        )(y)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        y = attn_fn(q, k, v)
+        y = nn.DenseGeneral(dim, axis=(-2, -1), dtype=self.dtype, name="attn_out")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = x + y
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.use_moe:
+            y = MoEMlp(
+                num_experts=self.num_experts,
+                hidden_dim=self.mlp_dim,
+                num_groups=self.moe_num_groups,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
+        else:
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(dim, dtype=self.dtype, name="mlp_out")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Token-in, next-token-logits-out causal LM.
+
+    ``moe_every=k`` makes every k-th block (1-indexed) a MoE block; 0 = dense.
+    """
+
+    vocab_size: int
+    hidden_dim: int = 512
+    depth: int = 8
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    max_len: int = 2048
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    attention_impl: str = "auto"
+    mesh: Any = None
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_num_groups: int = 1
+    tie_embeddings: bool = True
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, *, train: bool = False) -> jax.Array:
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
+        embed = nn.Embed(
+            self.vocab_size,
+            self.hidden_dim,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="embed",
+        )
+        x = embed(tokens).astype(self.dtype)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.hidden_dim),
+            jnp.float32,
+        )
+        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(x.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = DecoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                self.dropout_rate,
+                dtype=self.dtype,
+                attention_impl=self.attention_impl,
+                mesh=self.mesh,
+                use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
+                num_experts=self.num_experts,
+                moe_num_groups=self.moe_num_groups,
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.tie_embeddings:
+            logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        else:
+            logits = nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(
+                x.astype(jnp.float32)
+            )
+        return logits
+
+
+def GPTSmall(vocab_size: int = 50257, dtype: Any = jnp.float32, **kw) -> TransformerLM:
+    """GPT-2-small-shaped config (117M dense params)."""
+    kw.setdefault("max_len", 1024)
+    return TransformerLM(
+        vocab_size=vocab_size,
+        hidden_dim=768,
+        depth=12,
+        num_heads=12,
+        mlp_dim=3072,
+        dtype=dtype,
+        **kw,
+    )
+
+
+def LMTiny(vocab_size: int = 256, dtype: Any = jnp.float32, **kw) -> TransformerLM:
+    """Small variant for tests."""
+    kw.setdefault("max_len", 128)
+    return TransformerLM(
+        vocab_size=vocab_size,
+        hidden_dim=32,
+        depth=2,
+        num_heads=4,
+        mlp_dim=64,
+        dtype=dtype,
+        **kw,
+    )

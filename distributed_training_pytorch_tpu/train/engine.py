@@ -181,7 +181,8 @@ class TrainEngine:
         # never exist unsharded anywhere.
         abstract = jax.eval_shape(make, init_rng, state_rng)
         out_shardings = self.state_sharding(abstract)
-        return jax.jit(make, out_shardings=out_shardings)(init_rng, state_rng)
+        with self._ambient_mesh():  # in-model constraints resolve (see below)
+            return jax.jit(make, out_shardings=out_shardings)(init_rng, state_rng)
 
     # -- compiled bodies --------------------------------------------------
 
@@ -253,18 +254,30 @@ class TrainEngine:
 
     # -- public API -------------------------------------------------------
 
+    def _ambient_mesh(self):
+        """Make ``self.mesh`` the ambient mesh while tracing/dispatching.
+
+        Models annotate internal layouts with bare ``PartitionSpec``s via
+        ``with_sharding_constraint`` (e.g. ``parallel.moe``'s expert-sharded
+        buffers) — those resolve against the ambient mesh, which plain
+        ``jax.jit`` with explicit NamedShardings does NOT establish. Without
+        this, in-model constraints would silently no-op on the engine path."""
+        return jax.sharding.set_mesh(self.mesh)
+
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         """One compiled optimizer step on a global batch. Metrics are device
         arrays (global means) — call ``jax.device_get`` only when logging."""
         self._build_steps(state)
-        return self._train_step(state, batch)
+        with self._ambient_mesh():
+            return self._train_step(state, batch)
 
     def eval_step(self, state: TrainState, batch) -> dict:
         """Collective validation step — replaces the reference's rank-0-only,
         non-distributed ``validate`` (``trainer/trainer.py:184-206``): every
         device evaluates its shard and metrics reduce globally."""
         self._build_steps(state)
-        return self._eval_step(state, batch)
+        with self._ambient_mesh():
+            return self._eval_step(state, batch)
 
     def shard_batch(self, batch):
         """Host-local rows -> one global data-sharded array (see
@@ -282,7 +295,8 @@ class TrainEngine:
         on the VGG16/v5e step; see utils/tpu.py) without touching global
         XLA_FLAGS."""
         self._build_steps(state)
-        lowered = self._train_step.lower(state, batch)
+        with self._ambient_mesh():
+            lowered = self._train_step.lower(state, batch)
         if compiler_options:
             return lowered.compile(compiler_options=dict(compiler_options))
         return lowered.compile()

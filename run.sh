@@ -21,8 +21,12 @@ cd "$(dirname "$0")"
 #   resnet50         -> config 3:   ResNet-50 / ImageNet-1k
 #   vit_b16          -> config 4:   ViT-B/16  / ImageNet-1k
 #   convnext_l       -> config 5:   ConvNeXt-L / ImageNet-21k (bf16 + grad-accum)
+#   lm               -> causal-LM entry (long-context family; LM_SIZE=tiny|small)
 MODEL="${MODEL:-vgg16}"
 if [ "$MODEL" = "vgg16" ]; then
   exec python examples/train_cifar10.py "$@"
+fi
+if [ "$MODEL" = "lm" ]; then
+  exec python examples/train_lm.py "$@"
 fi
 exec python examples/train_imagenet.py "$@"

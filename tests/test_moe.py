@@ -109,7 +109,42 @@ def test_moe_grouped_routing_matches_dense(devices):
 def test_moe_rejects_indivisible_groups():
     model = MoEMlp(num_experts=2, hidden_dim=4, num_groups=3)
     x = jnp.ones((1, 8, 4))  # 8 tokens, 3 groups
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="not divisible by num_groups"):
+    with pytest.raises(ValueError, match="not divisible by num_groups"):
         model.init(jax.random.key(0), x)
+
+
+def test_engine_establishes_ambient_mesh(devices):
+    """Regression: TrainEngine must set the ambient mesh while tracing, or
+    in-model with_sharding_constraint (bare PartitionSpecs, as MoE uses)
+    silently no-ops on the production path."""
+    import optax
+    from flax import linen as nn
+
+    from distributed_training_pytorch_tpu.train import TrainEngine
+
+    mesh = mesh_lib.create_mesh(
+        {mesh_lib.DATA_AXIS: 2, EXPERT_AXIS: 4}, devices=devices
+    )
+    seen = []
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train=False):
+            seen.append(jax.sharding.get_abstract_mesh().axis_names)
+            return nn.Dense(3)(x.reshape(x.shape[0], -1))
+
+    model = Probe()
+
+    def loss_fn(params, ms, batch, rng, train):
+        logits = model.apply({"params": params}, batch["image"], train=train)
+        loss = jnp.mean(logits**2)
+        return loss, ({"loss": loss}, ms)
+
+    engine = TrainEngine(loss_fn, optax.sgd(0.01), mesh)
+    state = engine.init_state(
+        jax.random.key(0), lambda r: model.init(r, jnp.zeros((1, 4)))
+    )
+    batch = engine.shard_batch({"image": np.zeros((8, 4), np.float32)})
+    engine.train_step(state, batch)
+    assert seen and all(EXPERT_AXIS in axes for axes in seen if axes), seen
+    assert any(axes for axes in seen), "ambient mesh was never set during trace"

@@ -52,6 +52,7 @@ class CheckpointManager:
         *,
         save_best_for: tuple[str, str] | None = None,
         async_save: bool = True,
+        max_to_keep: int | None = None,
     ):
         self.directory = os.path.abspath(os.fspath(directory))
         if jax.process_index() == 0:
@@ -61,6 +62,11 @@ class CheckpointManager:
             if mode not in ("geq", "leq"):
                 raise ValueError(f"save_best_for mode must be 'geq' or 'leq', got {mode!r}")
         self.save_best_for = save_best_for
+        # Retention for the PERIODIC checkpoints only (checkpoint_epoch_N):
+        # keep the newest `max_to_keep`, delete older ones after each commit.
+        # `best`/`last` are policy names, never garbage-collected. Deletion
+        # runs on process 0 (shared-filesystem assumption, same as Orbax's).
+        self.max_to_keep = max_to_keep
         self._best_value: float | None = None
         handler = ocp.CompositeCheckpointHandler()
         self._ckptr = (
@@ -88,6 +94,7 @@ class CheckpointManager:
         — the asymmetry is the caller's policy, not the store's).
         """
         self.wait()  # a name may be overwritten; finish any in-flight save first
+        self._gc_periodic()  # previous save is committed; safe to prune now
         meta = {"epoch": int(epoch), "best_value": self._best_value}
         if metrics is not None:
             meta["metrics"] = {k: float(v) for k, v in metrics.items()}
@@ -198,8 +205,27 @@ class CheckpointManager:
         if isinstance(self._ckptr, ocp.AsyncCheckpointer):
             self._ckptr.wait_until_finished()
 
+    def _gc_periodic(self) -> None:
+        """Prune committed ``checkpoint_epoch_N`` dirs beyond ``max_to_keep``
+        (newest kept). Call only with no save in flight."""
+        if self.max_to_keep is None or jax.process_index() != 0:
+            return
+        import re
+        import shutil
+
+        pattern = re.compile(r"^checkpoint_epoch_(\d+)$")
+        found = []
+        for entry in os.listdir(self.directory):
+            match = pattern.match(entry)
+            if match and os.path.isdir(self.path(entry)):
+                found.append((int(match.group(1)), entry))
+        found.sort()
+        for _, entry in found[: max(0, len(found) - self.max_to_keep)]:
+            shutil.rmtree(self.path(entry), ignore_errors=True)
+
     def close(self) -> None:
         self.wait()
+        self._gc_periodic()
         self._ckptr.close()
 
     def __enter__(self) -> "CheckpointManager":

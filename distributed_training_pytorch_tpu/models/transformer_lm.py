@@ -70,6 +70,8 @@ class DecoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
         dim = x.shape[-1]
+        if dim % self.num_heads:
+            raise ValueError(f"hidden dim {dim} not divisible by {self.num_heads} heads")
         head_dim = dim // self.num_heads
         attn_fn = _causal_attention_fn(self.attention_impl, self.mesh)
 

@@ -95,6 +95,7 @@ class Trainer:
         progress: bool = True,
         save_on_preemption: bool = True,
         preemption_check_every: int = 20,
+        max_checkpoints_to_keep: int | None = None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -146,6 +147,7 @@ class Trainer:
             self.save_weight_folder,
             save_best_for=save_best_for,
             async_save=async_checkpoint,
+            max_to_keep=max_checkpoints_to_keep,
         )
 
         # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE

@@ -35,7 +35,10 @@ from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
 def load_windows(seq_len: int) -> np.ndarray:
     """[N, seq_len+1] int32 byte windows (input = [:-1], target = [1:])."""
     path = os.environ.get("LM_CORPUS")
-    if path and os.path.exists(path):
+    if path:
+        if not os.path.exists(path):
+            # A typo'd path must not silently train on synthetic data.
+            raise FileNotFoundError(f"LM_CORPUS={path!r} does not exist")
         data = np.frombuffer(open(path, "rb").read(), dtype=np.uint8)
     else:
         print("WARNING: LM_CORPUS unset — synthetic structured byte stream")
@@ -44,10 +47,14 @@ def load_windows(seq_len: int) -> np.ndarray:
         motifs = [rng.randint(0, 255, size=(m,)) for m in (5, 9, 13)]
         parts = [motifs[rng.randint(3)] for _ in range(60000)]
         data = np.concatenate(parts).astype(np.uint8)
-    n = (len(data) - 1) // seq_len
-    windows = np.stack(
-        [data[i * seq_len : i * seq_len + seq_len + 1] for i in range(n)]
-    )
+    if len(data) < seq_len + 1:
+        raise ValueError(
+            f"corpus has {len(data)} bytes — too short for SEQ_LEN={seq_len} "
+            "(need at least seq_len + 1)"
+        )
+    # One vectorized strided pass (a per-window Python loop costs tens of
+    # seconds and a large transient at GB-corpus scale).
+    windows = np.lib.stride_tricks.sliding_window_view(data, seq_len + 1)[::seq_len]
     return windows.astype(np.int32)
 
 

@@ -140,3 +140,18 @@ def test_logger(tmp_path, capsys):
     content = log_file.read_text()
     assert "hello" in content and "WARNING" in content and "ERROR" in content
     assert "default path" in content
+
+
+def test_max_to_keep_prunes_periodic_only(tmp_path, shared):
+    """Retention keeps the newest N checkpoint_epoch_* and never touches
+    best/last."""
+    _, state, _ = shared
+    mgr = CheckpointManager(tmp_path / "c", async_save=False, max_to_keep=2)
+    for ep in (1, 2, 3, 4):
+        mgr.save(epoch_checkpoint_name(ep), state, epoch=ep)
+    mgr.save(LAST, state, epoch=5)  # triggers gc of committed periodics
+    mgr.close()
+    kept = sorted(p.name for p in (tmp_path / "c").iterdir())
+    assert "last" in kept
+    assert "checkpoint_epoch_4" in kept and "checkpoint_epoch_3" in kept
+    assert "checkpoint_epoch_1" not in kept and "checkpoint_epoch_2" not in kept

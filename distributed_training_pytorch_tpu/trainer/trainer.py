@@ -62,6 +62,7 @@ from distributed_training_pytorch_tpu.checkpoint import (
 from distributed_training_pytorch_tpu.data import ShardedLoader, device_prefetch
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+from distributed_training_pytorch_tpu.utils.tensorboard import MetricsWriter
 
 
 class Trainer:
@@ -96,6 +97,7 @@ class Trainer:
         save_on_preemption: bool = True,
         preemption_check_every: int = 20,
         max_checkpoints_to_keep: int | None = None,
+        tensorboard_dir: str | None = None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -139,6 +141,8 @@ class Trainer:
         # every` steps all hosts vote (one tiny allgather — the only intra-
         # epoch host sync besides log_every). 0 = epoch boundaries only.
         self.preemption_check_every = preemption_check_every
+        # Optional TensorBoard scalars (SURVEY §5.5 upgrade; process 0 only).
+        self.metrics_writer = MetricsWriter(tensorboard_dir)
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
@@ -242,8 +246,10 @@ class Trainer:
             # Stop owning the process SIGTERM once training is over (or died):
             # a lingering handler would silently swallow later terminations.
             # Symmetric with the install above, so a re-entered train() is
-            # protected again.
+            # protected again. The metrics writer closes here too so the
+            # preemption early-return and error paths flush it.
             self._restore_sigterm()
+            self.metrics_writer.close()
 
     def _train_loop(self) -> None:
         best_banner: dict | None = None
@@ -310,8 +316,10 @@ class Trainer:
             for k, v in epoch_metrics.items():
                 msg += f" | {k} = {v} | "
             self.log(msg)
+            self.metrics_writer.write(int(self.state.step), epoch_metrics, prefix="train")
 
         self.checkpoints.wait()
+        self.metrics_writer.close()
         self.log("Finished!")
 
     def train_epoch(self, epoch: int) -> dict:
@@ -495,6 +503,7 @@ class Trainer:
         for k, v in avg.items():
             msg += f" | {k} = {v} | "
         self.log(msg)
+        self.metrics_writer.write(int(self.state.step), avg, prefix="val")
         return avg
 
     # ------------------------------------------------------------------

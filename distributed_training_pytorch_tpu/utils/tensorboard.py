@@ -1,0 +1,52 @@
+"""Optional TensorBoard metrics writer — SURVEY.md §5.5's named upgrade.
+
+The reference logs metrics only to console + file (``utils/logger.py``); the
+TPU-equivalent observability stack adds a TensorBoard scalar stream next to
+the profiler traces (``utils.profiling``), so one TensorBoard instance shows
+both. Backend: ``tensorboardX`` when importable, else a no-op (the framework
+never hard-depends on it). Process 0 writes; other hosts get a no-op writer —
+metrics are global (collectively reduced) so one writer sees everything.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+
+__all__ = ["MetricsWriter"]
+
+
+class MetricsWriter:
+    """Scalar writer: ``writer.write(step, {"loss": ...}, prefix="train")``."""
+
+    def __init__(self, log_dir: str | None):
+        self._writer = None
+        if log_dir and jax.process_index() == 0:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._writer = SummaryWriter(log_dir)
+            except ImportError:
+                pass  # stay a no-op; console/file logging still covers metrics
+
+    @property
+    def active(self) -> bool:
+        return self._writer is not None
+
+    def write(self, step: int, metrics: Mapping, prefix: str = "") -> None:
+        if self._writer is None:
+            return
+        for key, value in metrics.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue  # non-scalar entries are not TensorBoard material
+            tag = f"{prefix}/{key}" if prefix else key
+            self._writer.add_scalar(tag, value, int(step))
+        self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

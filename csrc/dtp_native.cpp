@@ -121,22 +121,21 @@ static void jpeg_err_exit(j_common_ptr cinfo) {
   longjmp(err->jb, 1);
 }
 
-// Decode JPEG file -> RGB u8 buffer (malloc'd). Returns nullptr on failure.
-static uint8_t* decode_jpeg(FILE* f, int* h, int* w) {
+// ---- in-memory decoders (file path slurps and delegates) ------------------
+
+static uint8_t* decode_jpeg_mem(const uint8_t* data, size_t len, int* h, int* w) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
-  // volatile: modified between setjmp and longjmp, read in the error path —
-  // non-volatile locals are indeterminate there per the C standard.
-  uint8_t* volatile buf = nullptr;
+  uint8_t* volatile buf = nullptr;  // setjmp liveness, see decode_jpeg
   if (setjmp(jerr.jb)) {
     jpeg_destroy_decompress(&cinfo);
     free(buf);
     return nullptr;
   }
   jpeg_create_decompress(&cinfo);
-  jpeg_stdio_src(&cinfo, f);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
   jpeg_start_decompress(&cinfo);
@@ -152,23 +151,32 @@ static uint8_t* decode_jpeg(FILE* f, int* h, int* w) {
   return buf;
 }
 
-// Decode PNG file -> RGB u8 buffer (malloc'd). Returns nullptr on failure.
-static uint8_t* decode_png(FILE* f, int* h, int* w) {
+struct PngMemReader {
+  const uint8_t* data;
+  size_t len, pos;
+};
+
+static void png_mem_read(png_structp png, png_bytep out, png_size_t count) {
+  PngMemReader* r = (PngMemReader*)png_get_io_ptr(png);
+  if (r->pos + count > r->len) png_error(png, "png: read past end of buffer");
+  memcpy(out, r->data + r->pos, count);
+  r->pos += count;
+}
+
+static uint8_t* decode_png_mem(const uint8_t* data, size_t len, int* h, int* w) {
   png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
   if (!png) return nullptr;
   png_infop info = png_create_info_struct(png);
-  // volatile + malloc (not std::vector): both are modified between setjmp and
-  // longjmp and read in the error path — non-volatile locals are
-  // indeterminate there, and a vector's destructor would run on garbage.
   uint8_t* volatile buf = nullptr;
   png_bytep* volatile rows = nullptr;
+  PngMemReader reader{data, len, 0};
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     free(buf);
     free(rows);
     return nullptr;
   }
-  png_init_io(png, f);
+  png_set_read_fn(png, &reader, png_mem_read);
   png_read_info(png, info);
   *w = png_get_image_width(png, info);
   *h = png_get_image_height(png, info);
@@ -192,20 +200,29 @@ static uint8_t* decode_png(FILE* f, int* h, int* w) {
   return buf;
 }
 
+static uint8_t* decode_bytes(const uint8_t* data, size_t len, int* h, int* w) {
+  if (len >= 2 && data[0] == 0xFF && data[1] == 0xD8)
+    return decode_jpeg_mem(data, len, h, w);
+  if (len >= 8 && png_sig_cmp(const_cast<png_bytep>(data), 0, 8) == 0)
+    return decode_png_mem(data, len, h, w);
+  return nullptr;
+}
+
+// File path: slurp and delegate, so there is exactly ONE decoder per format
+// (the mem/file paths previously duplicated the setjmp/transform logic).
 static uint8_t* decode_file(const char* path, int* h, int* w) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
-  uint8_t magic[8] = {0};
-  size_t got = fread(magic, 1, 8, f);
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
   rewind(f);
-  uint8_t* buf = nullptr;
-  if (got >= 2 && magic[0] == 0xFF && magic[1] == 0xD8) {
-    buf = decode_jpeg(f, h, w);
-  } else if (got >= 8 && png_sig_cmp(magic, 0, 8) == 0) {
-    buf = decode_png(f, h, w);
-  }
+  if (size <= 0) { fclose(f); return nullptr; }
+  uint8_t* data = (uint8_t*)malloc((size_t)size);
+  size_t got = fread(data, 1, (size_t)size, f);
   fclose(f);
-  return buf;
+  uint8_t* out = (got == (size_t)size) ? decode_bytes(data, got, h, w) : nullptr;
+  free(data);
+  return out;
 }
 
 // ------------------------------------------------------------------ helpers
@@ -246,6 +263,20 @@ struct DecodeArgs {
   std::atomic<int64_t>* failed;
 };
 
+// img (h x w RGB, freed here) -> resized + normalized floats at out slot i.
+static void resize_normalize_into(uint8_t* img, int h, int w, int out_h,
+                                  int out_w, const float* mean,
+                                  const float* stdv, float* out, int64_t i) {
+  std::vector<uint8_t> resized((size_t)out_h * out_w * 3);
+  bilinear_resize_u8(img, h, w, resized.data(), out_h, out_w);
+  free(img);
+  float* dst = out + (size_t)i * out_h * out_w * 3;
+  const size_t npx = (size_t)out_h * out_w;
+  for (size_t px = 0; px < npx; ++px)
+    for (int c = 0; c < 3; ++c)
+      dst[px * 3 + c] = (resized[px * 3 + c] / 255.0f - mean[c]) / stdv[c];
+}
+
 static void decode_one(int64_t i, void* p) {
   DecodeArgs* a = (DecodeArgs*)p;
   int h = 0, w = 0;
@@ -255,14 +286,7 @@ static void decode_one(int64_t i, void* p) {
     a->failed->compare_exchange_strong(expect, i);
     return;
   }
-  std::vector<uint8_t> resized((size_t)a->out_h * a->out_w * 3);
-  bilinear_resize_u8(img, h, w, resized.data(), a->out_h, a->out_w);
-  free(img);
-  float* dst = a->out + (size_t)i * a->out_h * a->out_w * 3;
-  const size_t npx = (size_t)a->out_h * a->out_w;
-  for (size_t px = 0; px < npx; ++px)
-    for (int c = 0; c < 3; ++c)
-      dst[px * 3 + c] = (resized[px * 3 + c] / 255.0f - a->mean[c]) / a->stdv[c];
+  resize_normalize_into(img, h, w, a->out_h, a->out_w, a->mean, a->stdv, a->out, i);
 }
 
 int64_t dtp_decode_resize_normalize(const char* const* paths, int64_t n,
@@ -271,6 +295,41 @@ int64_t dtp_decode_resize_normalize(const char* const* paths, int64_t n,
   std::atomic<int64_t> failed(-1);
   DecodeArgs a{paths, out_h, out_w, mean, stdv, out, &failed};
   run_parallel(n, threads, decode_one, &a);
+  return failed.load() >= 0 ? failed.load() + 1 : 0;
+}
+
+// Same batch kernel over in-memory payloads (record-file shards): one
+// contiguous byte blob + per-record offsets/lengths.
+struct DecodeBytesArgs {
+  const uint8_t* blob;
+  const int64_t* offsets;
+  const int64_t* lengths;
+  int out_h, out_w;
+  const float* mean;
+  const float* stdv;
+  float* out;
+  std::atomic<int64_t>* failed;
+};
+
+static void decode_bytes_one(int64_t i, void* p) {
+  DecodeBytesArgs* a = (DecodeBytesArgs*)p;
+  int h = 0, w = 0;
+  uint8_t* img = decode_bytes(a->blob + a->offsets[i], (size_t)a->lengths[i], &h, &w);
+  if (!img) {
+    int64_t expect = -1;
+    a->failed->compare_exchange_strong(expect, i);
+    return;
+  }
+  resize_normalize_into(img, h, w, a->out_h, a->out_w, a->mean, a->stdv, a->out, i);
+}
+
+extern "C" int64_t dtp_decode_resize_normalize_bytes(
+    const uint8_t* blob, const int64_t* offsets, const int64_t* lengths,
+    int64_t n, int out_h, int out_w, const float* mean, const float* stdv,
+    float* out, int threads) {
+  std::atomic<int64_t> failed(-1);
+  DecodeBytesArgs a{blob, offsets, lengths, out_h, out_w, mean, stdv, out, &failed};
+  run_parallel(n, threads, decode_bytes_one, &a);
   return failed.load() >= 0 ? failed.load() + 1 : 0;
 }
 

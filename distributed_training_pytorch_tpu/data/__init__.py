@@ -6,6 +6,7 @@ from distributed_training_pytorch_tpu.data.dataset import (  # noqa: F401
 from distributed_training_pytorch_tpu.data import native  # noqa: F401
 from distributed_training_pytorch_tpu.data.loader import ShardedLoader  # noqa: F401
 from distributed_training_pytorch_tpu.data.records import (  # noqa: F401
+    NativeRecordFileSource,
     RecordFileSource,
     RecordFileWriter,
     pack_image_folder,

@@ -102,6 +102,10 @@ def _load():
         lib.dtp_augment_crop_flip_u8.argtypes = [
             u8ptr, i64, i32, i32, i32, u64, u64, i64ptr, i32, u8ptr, i32,
         ]
+        lib.dtp_decode_resize_normalize_bytes.restype = i64
+        lib.dtp_decode_resize_normalize_bytes.argtypes = [
+            u8ptr, i64ptr, i64ptr, i64, i32, i32, fptr, fptr, fptr, i32,
+        ]
         _lib = lib
         return _lib
 
@@ -139,6 +143,39 @@ def decode_resize_normalize(
     )
     if rc:
         raise ValueError(f"failed to decode {paths[rc - 1]!r}")
+    return out
+
+
+def decode_resize_normalize_bytes(
+    payloads: Sequence[bytes],
+    height: int,
+    width: int,
+    mean: np.ndarray,
+    std: np.ndarray,
+    *,
+    threads: int | None = None,
+) -> np.ndarray:
+    """In-memory JPEG/PNG payloads (record-file shards) -> [N, H, W, 3]
+    float32, resized + normalized in one native call (no temp files)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(payloads)
+    lengths = np.asarray([len(p) for p in payloads], np.int64)
+    offsets = np.zeros(n, np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    # read-only view is fine: the native call only reads, and the ndpointer
+    # argtype requires C_CONTIGUOUS, not WRITEABLE.
+    blob = np.frombuffer(b"".join(payloads), np.uint8)
+    out = np.empty((n, height, width, 3), np.float32)
+    rc = lib.dtp_decode_resize_normalize_bytes(
+        blob, offsets, lengths, n, height, width,
+        np.ascontiguousarray(mean, np.float32),
+        np.ascontiguousarray(std, np.float32),
+        out, _threads(threads),
+    )
+    if rc:
+        raise ValueError(f"failed to decode record payload #{rc - 1}")
     return out
 
 

@@ -174,6 +174,57 @@ class RecordFileSource:
                 pass
 
 
+class NativeRecordFileSource(RecordFileSource):
+    """Record source whose batches decode+resize+normalize in one call into
+    the native C++ runtime (``data/native.py`` in-memory decoders) — the
+    no-augmentation (val/eval) hot path for record shards, mirror of
+    ``dataset.NativeImageFolderSource``. Python per-record fallback when the
+    native library is unavailable."""
+
+    def __init__(self, pattern: str, height: int, width: int, mean=None, std=None):
+        from distributed_training_pytorch_tpu.data import native, transforms
+
+        super().__init__(pattern, transform=None)
+        self.height, self.width = height, width
+        self.mean = transforms.IMAGENET_MEAN if mean is None else np.asarray(mean, np.float32)
+        self.std = transforms.IMAGENET_STD if std is None else np.asarray(std, np.float32)
+        self._native = native if native.available() else None
+        self._py_transform = transforms.Compose(
+            [transforms.resize(height, width), transforms.normalize(self.mean, self.std)]
+        )
+        if self._native is None:
+            self.transform = self._py_transform
+
+    @staticmethod
+    def _native_decodable(payload: bytes) -> bool:
+        # the csrc decoders handle JPEG and PNG; anything else (bmp/webp from
+        # a packed image folder) falls back to the Python path per record
+        return payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
+        payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
+        labels = np.asarray(labels, np.int32)
+        if self._native is not None:
+            native_pos = [p for p, pl in enumerate(payloads) if self._native_decodable(pl)]
+            images = np.empty((len(rows), self.height, self.width, 3), np.float32)
+            if native_pos:
+                decoded = self._native.decode_resize_normalize_bytes(
+                    [payloads[p] for p in native_pos],
+                    self.height,
+                    self.width,
+                    self.mean,
+                    self.std,
+                )
+                images[native_pos] = decoded
+            for p in set(range(len(rows))) - set(native_pos):
+                images[p] = self._py_transform(self.decode(payloads[p]))
+        else:
+            images = np.stack(
+                [self._py_transform(self.decode(p)) for p in payloads]
+            )
+        return {"image": images, "label": labels}
+
+
 def decode_image_bytes(payload: bytes) -> np.ndarray:
     """JPEG/PNG bytes -> RGB uint8 HWC (cv2 with PIL fallback), matching the
     folder source's ``_decode_image`` contract."""

@@ -319,7 +319,6 @@ class Trainer:
             self.metrics_writer.write(int(self.state.step), epoch_metrics, prefix="train")
 
         self.checkpoints.wait()
-        self.metrics_writer.close()
         self.log("Finished!")
 
     def train_epoch(self, epoch: int) -> dict:

@@ -123,9 +123,16 @@ class ImageNetTrainer(Trainer):
         return source
 
     def build_val_dataset(self):
-        tfm = eval_transform(self.image_size)
         if self.val_records:
-            return RecordFileSource(self.val_records, transform=tfm)
+            # Native batch path: record payloads decode+resize+normalize in
+            # one C++ call (data/records.NativeRecordFileSource); falls back
+            # to the per-record Python pipeline without the native lib.
+            from distributed_training_pytorch_tpu.data import NativeRecordFileSource
+
+            return NativeRecordFileSource(
+                self.val_records, height=self.image_size, width=self.image_size
+            )
+        tfm = eval_transform(self.image_size)
         return synthetic_source(1024, self.image_size, self.num_classes, tfm, seed=1)
 
     def build_model(self):

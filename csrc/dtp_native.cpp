@@ -218,6 +218,7 @@ static uint8_t* decode_file(const char* path, int* h, int* w) {
   rewind(f);
   if (size <= 0) { fclose(f); return nullptr; }
   uint8_t* data = (uint8_t*)malloc((size_t)size);
+  if (!data) { fclose(f); return nullptr; }
   size_t got = fread(data, 1, (size_t)size, f);
   fclose(f);
   uint8_t* out = (got == (size_t)size) ? decode_bytes(data, got, h, w) : nullptr;
@@ -298,11 +299,11 @@ int64_t dtp_decode_resize_normalize(const char* const* paths, int64_t n,
   return failed.load() >= 0 ? failed.load() + 1 : 0;
 }
 
-// Same batch kernel over in-memory payloads (record-file shards): one
-// contiguous byte blob + per-record offsets/lengths.
+// Same batch kernel over in-memory payloads (record-file shards): per-record
+// pointers + lengths (zero-copy from the caller's buffers, same shape as the
+// path-based entry).
 struct DecodeBytesArgs {
-  const uint8_t* blob;
-  const int64_t* offsets;
+  const uint8_t* const* bufs;
   const int64_t* lengths;
   int out_h, out_w;
   const float* mean;
@@ -314,7 +315,7 @@ struct DecodeBytesArgs {
 static void decode_bytes_one(int64_t i, void* p) {
   DecodeBytesArgs* a = (DecodeBytesArgs*)p;
   int h = 0, w = 0;
-  uint8_t* img = decode_bytes(a->blob + a->offsets[i], (size_t)a->lengths[i], &h, &w);
+  uint8_t* img = decode_bytes(a->bufs[i], (size_t)a->lengths[i], &h, &w);
   if (!img) {
     int64_t expect = -1;
     a->failed->compare_exchange_strong(expect, i);
@@ -323,12 +324,11 @@ static void decode_bytes_one(int64_t i, void* p) {
   resize_normalize_into(img, h, w, a->out_h, a->out_w, a->mean, a->stdv, a->out, i);
 }
 
-extern "C" int64_t dtp_decode_resize_normalize_bytes(
-    const uint8_t* blob, const int64_t* offsets, const int64_t* lengths,
-    int64_t n, int out_h, int out_w, const float* mean, const float* stdv,
-    float* out, int threads) {
+int64_t dtp_decode_resize_normalize_bytes(
+    const uint8_t* const* bufs, const int64_t* lengths, int64_t n, int out_h,
+    int out_w, const float* mean, const float* stdv, float* out, int threads) {
   std::atomic<int64_t> failed(-1);
-  DecodeBytesArgs a{blob, offsets, lengths, out_h, out_w, mean, stdv, out, &failed};
+  DecodeBytesArgs a{bufs, lengths, out_h, out_w, mean, stdv, out, &failed};
   run_parallel(n, threads, decode_bytes_one, &a);
   return failed.load() >= 0 ? failed.load() + 1 : 0;
 }

@@ -110,25 +110,27 @@ class NativeImageFolderSource(ImageFolderDataSource):
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         labels = np.array([self.records[int(i)][1] for i in rows], np.int32)
         if self._native is not None:
-            # Partition by POSITION (row indices can repeat under pad_final).
-            native_pos = [
-                p
-                for p, i in enumerate(rows)
-                if self.records[int(i)][0].lower().endswith(self._NATIVE_EXTS)
-            ]
-            images = np.empty((len(rows), self.height, self.width, 3), np.float32)
-            if native_pos:
-                decoded = self._native.decode_resize_normalize(
-                    [self.records[int(rows[p])][0] for p in native_pos],
+            from distributed_training_pytorch_tpu.data.native import mixed_native_batch
+
+            images = mixed_native_batch(
+                len(rows),
+                self.height,
+                self.width,
+                # Partition by POSITION (row indices repeat under pad_final).
+                [
+                    p
+                    for p, i in enumerate(rows)
+                    if self.records[int(i)][0].lower().endswith(self._NATIVE_EXTS)
+                ],
+                lambda pos: self._native.decode_resize_normalize(
+                    [self.records[int(rows[p])][0] for p in pos],
                     self.height,
                     self.width,
                     self.mean,
                     self.std,
-                )
-                images[native_pos] = decoded
-            fallback = set(range(len(rows))) - set(native_pos)
-            for p in fallback:
-                images[p] = self._decode_py(int(rows[p]))
+                ),
+                lambda p: self._decode_py(int(rows[p])),
+            )
         else:
             images = np.stack([self._decode_py(int(i)) for i in rows])
         return {"image": images, "label": labels}

@@ -104,7 +104,7 @@ def _load():
         ]
         lib.dtp_decode_resize_normalize_bytes.restype = i64
         lib.dtp_decode_resize_normalize_bytes.argtypes = [
-            u8ptr, i64ptr, i64ptr, i64, i32, i32, fptr, fptr, fptr, i32,
+            ctypes.POINTER(ctypes.c_char_p), i64ptr, i64, i32, i32, fptr, fptr, fptr, i32,
         ]
         _lib = lib
         return _lib
@@ -162,14 +162,12 @@ def decode_resize_normalize_bytes(
         raise RuntimeError("native library unavailable")
     n = len(payloads)
     lengths = np.asarray([len(p) for p in payloads], np.int64)
-    offsets = np.zeros(n, np.int64)
-    np.cumsum(lengths[:-1], out=offsets[1:])
-    # read-only view is fine: the native call only reads, and the ndpointer
-    # argtype requires C_CONTIGUOUS, not WRITEABLE.
-    blob = np.frombuffer(b"".join(payloads), np.uint8)
+    # Zero-copy: c_char_p elements point straight at each bytes object's
+    # buffer (lengths are passed explicitly; embedded NULs are fine).
+    bufs = (ctypes.c_char_p * n)(*payloads)
     out = np.empty((n, height, width, 3), np.float32)
     rc = lib.dtp_decode_resize_normalize_bytes(
-        blob, offsets, lengths, n, height, width,
+        bufs, lengths, n, height, width,
         np.ascontiguousarray(mean, np.float32),
         np.ascontiguousarray(std, np.float32),
         out, _threads(threads),
@@ -177,6 +175,23 @@ def decode_resize_normalize_bytes(
     if rc:
         raise ValueError(f"failed to decode record payload #{rc - 1}")
     return out
+
+
+def mixed_native_batch(n, height, width, native_positions, native_fn, py_fn) -> np.ndarray:
+    """Assemble a decoded batch where some rows take the native batch call and
+    the rest fall back per record (shared by the folder and record sources).
+
+    ``native_positions``: batch positions decodable natively (position-based —
+    row indices can repeat under pad_final). ``native_fn(positions)`` returns
+    the stacked native results for those positions; ``py_fn(position)`` one
+    fallback row.
+    """
+    images = np.empty((n, height, width, 3), np.float32)
+    if native_positions:
+        images[native_positions] = native_fn(native_positions)
+    for p in set(range(n)) - set(native_positions):
+        images[p] = py_fn(p)
+    return images
 
 
 def augment_crop_flip(

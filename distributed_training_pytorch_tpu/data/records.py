@@ -202,22 +202,21 @@ class NativeRecordFileSource(RecordFileSource):
         return payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n"
 
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
+        from distributed_training_pytorch_tpu.data.native import mixed_native_batch
+
         payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
         labels = np.asarray(labels, np.int32)
         if self._native is not None:
-            native_pos = [p for p, pl in enumerate(payloads) if self._native_decodable(pl)]
-            images = np.empty((len(rows), self.height, self.width, 3), np.float32)
-            if native_pos:
-                decoded = self._native.decode_resize_normalize_bytes(
-                    [payloads[p] for p in native_pos],
-                    self.height,
-                    self.width,
-                    self.mean,
-                    self.std,
-                )
-                images[native_pos] = decoded
-            for p in set(range(len(rows))) - set(native_pos):
-                images[p] = self._py_transform(self.decode(payloads[p]))
+            images = mixed_native_batch(
+                len(rows),
+                self.height,
+                self.width,
+                [p for p, pl in enumerate(payloads) if self._native_decodable(pl)],
+                lambda pos: self._native.decode_resize_normalize_bytes(
+                    [payloads[p] for p in pos], self.height, self.width, self.mean, self.std
+                ),
+                lambda p: self._py_transform(self.decode(payloads[p])),
+            )
         else:
             images = np.stack(
                 [self._py_transform(self.decode(p)) for p in payloads]

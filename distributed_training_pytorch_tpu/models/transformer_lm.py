@@ -55,7 +55,15 @@ def _causal_attention_fn(attention_impl: str, mesh):
 
 
 class DecoderBlock(nn.Module):
-    """Pre-LN decoder block: x + attn(ln(x)); x + ffn(ln(x))."""
+    """Pre-LN decoder block: x + attn(ln(x)); x + ffn(ln(x)).
+
+    ``decode=True`` runs single-token autoregressive mode: ``x`` is
+    ``[B, 1, d]``, and the block keeps a KV cache (``'cache'`` collection,
+    ``[B, max_len, H, Dh]`` per projection) updated in place with one
+    ``dynamic_update_slice`` per step — the standard TPU decode layout (static
+    shapes; the growing sequence is a write index, not a growing tensor).
+    ``max_len`` bounds the cache and is required for decode.
+    """
 
     num_heads: int
     mlp_dim: int
@@ -66,21 +74,59 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
     num_experts: int = 8
     moe_num_groups: int = 1
+    max_len: int = 2048
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, *, train: bool = False, decode: bool = False) -> jax.Array:
         dim = x.shape[-1]
         if dim % self.num_heads:
             raise ValueError(f"hidden dim {dim} not divisible by {self.num_heads} heads")
         head_dim = dim // self.num_heads
-        attn_fn = _causal_attention_fn(self.attention_impl, self.mesh)
 
         y = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.DenseGeneral(
             (3, self.num_heads, head_dim), axis=-1, dtype=self.dtype, name="qkv"
         )(y)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        y = attn_fn(q, k, v)
+        if decode:
+            if x.shape[1] != 1:
+                raise ValueError(f"decode mode consumes one token at a time, got T={x.shape[1]}")
+            if self.use_moe:
+                # Per-step routing sees B tokens with a tiny per-step capacity
+                # — silently different drop behavior than the training-time
+                # forward (which routes B*T tokens). Refuse rather than
+                # diverge quietly; decode for MoE LMs needs a dedicated
+                # inference router.
+                raise NotImplementedError(
+                    "KV-cache decode through MoE blocks is not supported; "
+                    "use a dense model (moe_every=0) for generation"
+                )
+            b = x.shape[0]
+            cached_k = self.variable(
+                "cache",
+                "cached_key",
+                lambda: jnp.zeros((b, self.max_len, self.num_heads, head_dim), self.dtype),
+            )
+            cached_v = self.variable(
+                "cache",
+                "cached_value",
+                lambda: jnp.zeros((b, self.max_len, self.num_heads, head_dim), self.dtype),
+            )
+            index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            i = index.value
+            cached_k.value = jax.lax.dynamic_update_slice_in_dim(cached_k.value, k, i, 1)
+            cached_v.value = jax.lax.dynamic_update_slice_in_dim(cached_v.value, v, i, 1)
+            index.value = i + 1
+            # q [B,1,H,Dh] against the cache prefix: mask positions > i.
+            scale = head_dim**-0.5
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, cached_k.value).astype(jnp.float32)
+            valid = jnp.arange(self.max_len) <= i
+            logits = jnp.where(valid[None, None, None, :], logits * scale, -1e30)
+            weights = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
+        else:
+            attn_fn = _causal_attention_fn(self.attention_impl, self.mesh)
+            y = attn_fn(q, k, v)
         y = nn.DenseGeneral(dim, axis=(-2, -1), dtype=self.dtype, name="attn_out")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -124,7 +170,9 @@ class TransformerLM(nn.Module):
     tie_embeddings: bool = True
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, *, train: bool = False) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, *, train: bool = False, decode: bool = False
+    ) -> jax.Array:
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -141,7 +189,13 @@ class TransformerLM(nn.Module):
             (1, self.max_len, self.hidden_dim),
             jnp.float32,
         )
-        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(x.dtype)
+        if decode:
+            # single-token step: position comes from the decode cache
+            position = self.variable("cache", "position", lambda: jnp.zeros((), jnp.int32))
+            x = x + jax.lax.dynamic_slice_in_dim(pos, position.value, 1, 1).astype(x.dtype)
+            position.value = position.value + 1
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for i in range(self.depth):
             x = DecoderBlock(
@@ -154,7 +208,8 @@ class TransformerLM(nn.Module):
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 num_experts=self.num_experts,
                 moe_num_groups=self.moe_num_groups,
-            )(x, train=train)
+                max_len=self.max_len,
+            )(x, train=train, decode=decode)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
@@ -163,6 +218,62 @@ class TransformerLM(nn.Module):
                 x.astype(jnp.float32)
             )
         return logits
+
+
+def generate(
+    model: TransformerLM,
+    variables,
+    prompt: jax.Array,
+    num_steps: int,
+    rng: jax.Array,
+    *,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Autoregressive sampling with the KV-cache decode path.
+
+    ``prompt`` is ``[B, P]`` int32; returns ``[B, P + num_steps]``. One
+    ``lax.scan`` covers prefill and generation — every step is a single-token
+    cached decode (static shapes throughout; jit-compatible).
+    ``temperature=0`` is greedy; otherwise softmax sampling at that
+    temperature.
+    """
+    b, p = prompt.shape
+    total = p + num_steps
+    if total > model.max_len:
+        raise ValueError(f"prompt {p} + steps {num_steps} exceeds max_len {model.max_len}")
+    params = {k: v for k, v in variables.items() if k != "cache"}
+
+    # The cache initializes to zeros (its variable defaults), so its structure
+    # from eval_shape IS its initial value.
+    cache_shapes = jax.eval_shape(
+        lambda: model.apply(params, prompt[:, :1], decode=True, mutable=["cache"])
+    )[1]["cache"]
+    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    def step(carry, t):
+        token, cache, rng = carry
+        logits, updated = model.apply(
+            {**params, "cache": cache}, token, decode=True, mutable=["cache"]
+        )
+        logits = logits[:, 0, :]  # [B, V]
+        rng, sample_rng = jax.random.split(rng)
+        if temperature > 0.0:
+            sampled = jax.random.categorical(sample_rng, logits / temperature, axis=-1)
+        else:
+            sampled = jnp.argmax(logits, axis=-1)
+        # While still inside the prompt, feed the ground-truth next token.
+        next_idx = jnp.minimum(t + 1, p - 1)
+        in_prompt = (t + 1) < p
+        next_token = jnp.where(
+            in_prompt, jax.lax.dynamic_index_in_dim(prompt, next_idx, 1), sampled[:, None]
+        )
+        return (next_token, updated["cache"], rng), next_token[:, 0]
+
+    (_, _, _), produced = jax.lax.scan(
+        step, (prompt[:, :1], cache0, rng), jnp.arange(total - 1)
+    )
+    # produced[t] is the token at position t+1.
+    return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
 
 
 def GPTSmall(vocab_size: int = 50257, dtype: Any = jnp.float32, **kw) -> TransformerLM:

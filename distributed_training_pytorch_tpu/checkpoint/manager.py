@@ -167,16 +167,22 @@ class CheckpointManager:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target_state)
         items = {
             "params": ocp.args.StandardRestore(abstract.params),
-            "rest": ocp.args.StandardRestore(
+            "meta": ocp.args.JsonRestore(),
+        }
+        if params_only:
+            # Restore `rest` as stored (no target structure): only its
+            # model_state is consumed, and imposing the target's rng layout
+            # would fail when the eval process uses a different PRNG impl
+            # than training did (threefry keys are 2 words, rbg 4).
+            items["rest"] = ocp.args.StandardRestore()
+        else:
+            items["rest"] = ocp.args.StandardRestore(
                 {
                     "step": abstract.step,
                     "rng": abstract.rng,
                     "model_state": abstract.model_state,
                 }
-            ),
-            "meta": ocp.args.JsonRestore(),
-        }
-        if not params_only:
+            )
             items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
         restored = self._ckptr.restore(path, args=ocp.args.Composite(**items))
         meta = restored.meta or {}

@@ -77,7 +77,14 @@ class DecoderBlock(nn.Module):
     max_len: int = 2048
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = False, decode: bool = False) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        decode_index: jax.Array | None = None,
+    ) -> jax.Array:
         dim = x.shape[-1]
         if dim % self.num_heads:
             raise ValueError(f"hidden dim {dim} not divisible by {self.num_heads} heads")
@@ -101,6 +108,8 @@ class DecoderBlock(nn.Module):
                     "KV-cache decode through MoE blocks is not supported; "
                     "use a dense model (moe_every=0) for generation"
                 )
+            if decode_index is None:
+                raise ValueError("decode=True requires decode_index (the model's step counter)")
             b = x.shape[0]
             cached_k = self.variable(
                 "cache",
@@ -112,11 +121,11 @@ class DecoderBlock(nn.Module):
                 "cached_value",
                 lambda: jnp.zeros((b, self.max_len, self.num_heads, head_dim), self.dtype),
             )
-            index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-            i = index.value
+            # One step counter lives on the model (the 'position' cache var);
+            # per-block copies would be redundant state with a desync hazard.
+            i = decode_index
             cached_k.value = jax.lax.dynamic_update_slice_in_dim(cached_k.value, k, i, 1)
             cached_v.value = jax.lax.dynamic_update_slice_in_dim(cached_v.value, v, i, 1)
-            index.value = i + 1
             # q [B,1,H,Dh] against the cache prefix: mask positions > i.
             scale = head_dim**-0.5
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, cached_k.value).astype(jnp.float32)
@@ -189,11 +198,13 @@ class TransformerLM(nn.Module):
             (1, self.max_len, self.hidden_dim),
             jnp.float32,
         )
+        decode_index = None
         if decode:
-            # single-token step: position comes from the decode cache
+            # single-token step: ONE position counter for the whole model
             position = self.variable("cache", "position", lambda: jnp.zeros((), jnp.int32))
-            x = x + jax.lax.dynamic_slice_in_dim(pos, position.value, 1, 1).astype(x.dtype)
-            position.value = position.value + 1
+            decode_index = position.value
+            x = x + jax.lax.dynamic_slice_in_dim(pos, decode_index, 1, 1).astype(x.dtype)
+            position.value = decode_index + 1
         else:
             x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -209,7 +220,7 @@ class TransformerLM(nn.Module):
                 num_experts=self.num_experts,
                 moe_num_groups=self.moe_num_groups,
                 max_len=self.max_len,
-            )(x, train=train, decode=decode)
+            )(x, train=train, decode=decode, decode_index=decode_index)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)

@@ -1,0 +1,131 @@
+"""Offline LM evaluation + sampling — the ``eval.py`` analog for the causal-LM
+family (beyond the reference's vision-only scope).
+
+Loads a ``train_lm.py`` checkpoint, reports byte-level validation NLL /
+perplexity over a corpus, and prints greedy + sampled continuations of a
+prompt through the KV-cache decode path (``models.transformer_lm.generate``).
+
+Usage::
+
+    python examples/eval_lm.py [checkpoint_dir] [corpus_file]
+
+Env knobs: ``SEQ_LEN`` (must match training, default 256), ``LM_SIZE``
+(``tiny`` | ``small``), ``EVAL_BATCH`` (default 64), ``PROMPT`` (text to
+continue; default a corpus prefix), ``GEN_STEPS`` (default 64),
+``TEMPERATURE`` (default 0.8; 0 = greedy only).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_pytorch_tpu.checkpoint import CheckpointManager
+from distributed_training_pytorch_tpu.models import GPTSmall, LMTiny
+from distributed_training_pytorch_tpu.models.transformer_lm import generate
+from distributed_training_pytorch_tpu.train import TrainState
+
+
+def build_model(size: str, seq_len: int, moe_every: int = 0):
+    factory = {"tiny": LMTiny, "small": GPTSmall}[size]
+    return factory(
+        vocab_size=256, dtype=jnp.bfloat16, max_len=max(seq_len, 128), moe_every=moe_every
+    )
+
+
+def load_params(checkpoint_dir: str, size: str, seq_len: int, moe_every: int = 0):
+    """(model, params) from a train_lm checkpoint — shared by evaluate/sample.
+    ``moe_every`` must match the training run (the param tree differs)."""
+    model = build_model(size, seq_len, moe_every)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, seq_len), jnp.int32)), jax.random.key(0)
+    )
+    target = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract["params"]),
+        opt_state=(),
+        model_state={},
+        rng=jax.random.key(0),
+    )
+    mgr = CheckpointManager(os.path.dirname(checkpoint_dir) or ".", async_save=False)
+    state, _ = mgr.restore(checkpoint_dir, target, params_only=True)
+    mgr.close()
+    return model, state.params
+
+
+def evaluate(checkpoint_dir: str, corpus: str, *, size="small", seq_len=256, batch=64,
+             moe_every=0, loaded=None):
+    """Returns {"nll": mean byte NLL, "ppl": perplexity, "n_windows": N}."""
+    from examples.train_lm import load_windows
+
+    os.environ["LM_CORPUS"] = corpus
+    windows = load_windows(seq_len)
+    model, params = loaded or load_params(checkpoint_dir, size, seq_len, moe_every)
+
+    @jax.jit
+    def batch_nll(params, toks):
+        logits = model.apply({"params": params}, toks[:, :-1])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
+        return jnp.sum(nll), nll.size
+
+    total, count, n_windows = 0.0, 0, 0
+    # Full batches, then the tail (each batch size compiles once; the tail
+    # adds at most one extra compile). Dropping the tail silently — or an
+    # empty corpus scoring nll=0 — would fabricate results.
+    for i in range(0, len(windows), batch):
+        chunk = windows[i : i + batch]
+        s, n = batch_nll(params, jnp.asarray(chunk))
+        total += float(s)
+        count += int(n)
+        n_windows += len(chunk)
+    if count == 0:
+        raise ValueError(f"no evaluation windows (corpus too short for SEQ_LEN={seq_len})")
+    nll = total / count
+    return {"nll": nll, "ppl": float(np.exp(nll)), "n_windows": n_windows}
+
+
+def sample(checkpoint_dir: str, prompt_text: bytes, *, size="small", seq_len=256,
+           gen_steps=64, temperature=0.8, moe_every=0, loaded=None):
+    model, params = loaded or load_params(checkpoint_dir, size, seq_len, moe_every)
+    prompt = jnp.asarray(np.frombuffer(prompt_text, np.uint8)[None, :], jnp.int32)
+    out = {}
+    out["greedy"] = bytes(
+        np.asarray(generate(model, {"params": params}, prompt, gen_steps,
+                            jax.random.key(0)))[0].astype(np.uint8)
+    )
+    if temperature > 0:
+        out[f"t={temperature}"] = bytes(
+            np.asarray(generate(model, {"params": params}, prompt, gen_steps,
+                                jax.random.key(1), temperature=temperature))[0].astype(np.uint8)
+        )
+    return out
+
+
+if __name__ == "__main__":
+    ckpt = sys.argv[1] if len(sys.argv) > 1 else "./runs/lm/weights/last"
+    corpus = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("LM_CORPUS", "")
+    size = os.environ.get("LM_SIZE", "small")
+    seq_len = int(os.environ.get("SEQ_LEN", "256"))
+    moe_every = int(os.environ.get("MOE_EVERY", "0"))  # must match training
+    loaded = load_params(ckpt, size, seq_len, moe_every)  # restore once
+    if corpus:
+        results = evaluate(ckpt, corpus, size=size, seq_len=seq_len,
+                           batch=int(os.environ.get("EVAL_BATCH", "64")), loaded=loaded)
+        print(f"VALIDATION: nll={results['nll']:.4f} ppl={results['ppl']:.2f} "
+              f"({results['n_windows']} windows)")
+    if moe_every == 0:  # generation needs the dense decode path
+        prompt = os.environ.get("PROMPT", "").encode() or b"the "
+        for name, text in sample(
+            ckpt, prompt, size=size, seq_len=seq_len,
+            gen_steps=int(os.environ.get("GEN_STEPS", "64")),
+            temperature=float(os.environ.get("TEMPERATURE", "0.8")), loaded=loaded,
+        ).items():
+            print(f"--- {name} ---")
+            print(text.decode("utf-8", errors="replace"))

@@ -155,3 +155,28 @@ def test_max_to_keep_prunes_periodic_only(tmp_path, shared):
     assert "last" in kept
     assert "checkpoint_epoch_4" in kept and "checkpoint_epoch_3" in kept
     assert "checkpoint_epoch_1" not in kept and "checkpoint_epoch_2" not in kept
+
+
+def test_params_only_restore_across_prng_impls(tmp_path, shared):
+    """A checkpoint saved by an rbg-keyed training run must restore
+    params_only into a threefry-keyed eval process (key widths differ: 4 vs 2
+    words) — regression for the eval_lm cross-impl failure."""
+    from distributed_training_pytorch_tpu.train import TrainState
+
+    _, state, _ = shared
+    rbg_state = state.replace(rng=jax.random.key(0, impl="rbg"))
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    mgr.save("last", rbg_state, epoch=3)
+
+    target = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=jax.tree.map(jnp.zeros_like, state.params),
+        opt_state=(),
+        model_state={},
+        rng=jax.random.key(0),  # default threefry (2 words)
+    )
+    restored, epoch = mgr.restore("last", target, params_only=True)
+    assert epoch == 3
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()

@@ -122,10 +122,19 @@ def ulysses_attention(
     *,
     axis: str = SEQ_AXIS,
     causal: bool = False,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """DeepSpeed-Ulysses sequence parallelism: all-to-all to head-sharded
     layout, dense local attention, all-to-all back. [B, T, H, D], T sharded
-    on ``axis``; requires H divisible by the axis size."""
+    on ``axis``; requires H divisible by the axis size.
+
+    ``use_flash``: run the local attention through the Pallas flash kernel —
+    after the all-to-all each device holds the FULL sequence for its head
+    group, exactly the long-T shape where the kernel beats XLA (and where the
+    O(T^2) score tensor may not even fit). None = auto: flash on TPU when the
+    global sequence is long enough (``ops.pallas.FLASH_MIN_SEQ_LEN``).
+    Differentiable either way (the kernel carries its own flash backward).
+    """
     if axis not in mesh.shape:
         raise ValueError(f"mesh has no axis {axis!r}")
     s = mesh.shape[axis]
@@ -143,12 +152,23 @@ def ulysses_attention(
 
         qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
         T = qh.shape[1]
-        bias = None
-        if causal:
-            pos = jnp.arange(T)
-            bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, _NEG_INF)[None, None]
-        o, m, l = _block_attn(qh, kh, vh, scale, bias)
-        o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        from distributed_training_pytorch_tpu.ops.pallas import (
+            FLASH_MIN_SEQ_LEN,
+            flash_attention,
+        )
+
+        flash = use_flash
+        if flash is None:
+            flash = jax.default_backend() == "tpu" and T >= FLASH_MIN_SEQ_LEN
+        if flash:
+            o = flash_attention(qh, kh, vh, causal=causal)
+        else:
+            bias = None
+            if causal:
+                pos = jnp.arange(T)
+                bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, _NEG_INF)[None, None]
+            o, m, l = _block_attn(qh, kh, vh, scale, bias)
+            o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return heads_to_seq(o.astype(q.dtype))
 
     spec = P(None, axis, None, None)

@@ -180,8 +180,17 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jax.Array, *, train: bool = False, decode: bool = False
+        self,
+        tokens: jax.Array,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        return_hidden: bool = False,
     ) -> jax.Array:
+        """``return_hidden=True`` skips the vocab projection and returns the
+        final-LN hidden states ``[B, T, d]`` — pair with
+        ``ops.losses.tied_cross_entropy`` (and the ``embed`` param) so training
+        never materializes the [B, T, V] float32 logits."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -222,6 +231,10 @@ class TransformerLM(nn.Module):
                 max_len=self.max_len,
             )(x, train=train, decode=decode, decode_index=decode_index)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        if return_hidden:
+            if not self.tie_embeddings:
+                raise ValueError("return_hidden requires tie_embeddings=True")
+            return x
         if self.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
         else:

@@ -184,7 +184,7 @@ class Trainer:
         self.optimizer = self.build_optimizer(self.schedule)
 
         self.engine = TrainEngine(
-            make_supervised_loss(self.model, self.criterion),
+            self.build_loss_fn(),
             self.optimizer,
             self.mesh,
             accum_steps=accum_steps,
@@ -526,6 +526,14 @@ class Trainer:
 
     def build_scheduler(self):
         raise NotImplementedError("Please implement the build_scheduler method")
+
+    def build_loss_fn(self):
+        """Advanced hook (beyond the reference's nine): the full functional
+        LossFn handed to the engine. The default composes ``build_model`` +
+        ``build_criterion`` the standard way; override when the loss needs
+        direct access to params (e.g. ``ops.losses.tied_cross_entropy`` fusing
+        a tied LM head so the [B, T, V] logits never materialize)."""
+        return make_supervised_loss(self.model, self.criterion)
 
     def preprocess_batch(self, batch: Mapping) -> Mapping:
         """Host-side batch hook. The reference uses this for the H2D copy

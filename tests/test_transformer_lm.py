@@ -172,3 +172,15 @@ def test_generate_sampling_is_seeded():
     c = generate(model, variables, prompt, 8, jax.random.key(6), temperature=1.0)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_return_hidden_matches_logits_projection():
+    """hidden @ E^T == the model's own logits (the fused-CE contract)."""
+    model = LMTiny(vocab_size=32, max_len=16)
+    toks = tokens_batch(2, 8, vocab=32, seed=9)
+    variables = model.init(jax.random.key(0), toks)
+    logits = model.apply(variables, toks)
+    hidden = model.apply(variables, toks, return_hidden=True)
+    emb = variables["params"]["embed"]["embedding"]
+    recon = hidden.astype(jnp.float32) @ emb.T.astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(recon), np.asarray(logits), atol=1e-5)

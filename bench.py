@@ -91,6 +91,15 @@ def vit_train_flops_per_image(model, image_size: int) -> float:
     return 3.0 * fwd
 
 
+def lm_train_flops_per_token(model, seq_len: int) -> float:
+    """Analytic causal-LM train FLOPs per token: 6*P_matmul + 12*L*T*d
+    attention (the standard 6N + attention convention; backward = 2x fwd
+    folded into the 6)."""
+    dm, L = model.hidden_dim, model.depth
+    p_matmul = L * (4 * dm * dm + 2 * dm * model.mlp_dim) + model.vocab_size * dm
+    return 6.0 * p_matmul + 12.0 * L * seq_len * dm
+
+
 def _build_vgg16(num_classes):
     return VGG16(num_classes=num_classes, dtype=jnp.bfloat16)
 
@@ -103,6 +112,50 @@ def _build_vit(num_classes):
     flash_env = os.environ.get("BENCH_FLASH", "auto")
     use_flash = {"auto": None, "1": True, "0": False}[flash_env]
     return ViTB16(num_classes=num_classes, dtype=jnp.bfloat16, use_flash=use_flash)
+
+
+def _build_lm(num_classes):
+    from distributed_training_pytorch_tpu.models import GPTSmall
+
+    del num_classes  # byte/GPT-2 vocab is part of the model config
+    return GPTSmall(dtype=jnp.bfloat16)
+
+
+def _image_batch(rng, batch, size, num_classes):
+    return {
+        "image": rng.randn(batch, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, num_classes, size=(batch,)).astype(np.int32),
+    }
+
+
+def _token_batch(rng, batch, size, num_classes):
+    return {
+        "image": rng.randint(0, num_classes, size=(batch, size)).astype(np.int32),
+        "label": rng.randint(0, num_classes, size=(batch, size)).astype(np.int32),
+    }
+
+
+def _image_example(size):
+    return jnp.zeros((1, size, size, 3))
+
+
+def _token_example(size):
+    return jnp.zeros((1, size), jnp.int32)
+
+
+def _supervised_loss(model):
+    def criterion(logits, b):
+        loss = cross_entropy_loss(logits, b["label"])
+        return loss, {"loss": loss, "accuracy": accuracy(logits, b["label"])}
+
+    return make_supervised_loss(model, criterion)
+
+
+def _lm_fused_loss(model):
+    # The training entry's exact loss (one implementation, bench == training).
+    from distributed_training_pytorch_tpu.models.transformer_lm import make_fused_lm_loss
+
+    return make_fused_lm_loss(model)
 
 
 # One source of truth per BENCH_MODEL: builder, flops fn, defaults, metric.
@@ -123,7 +176,27 @@ BENCH_MODELS = {
         "num_classes": 1000,
         "metric": "images/sec/chip (ViT-B/16, ImageNet-shape, bf16)",
     },
+    # size = sequence length; throughput unit is tokens (batch*T items/step).
+    "lm": {
+        "build": _build_lm,
+        "flops": lm_train_flops_per_token,
+        "batch": 64,
+        "image_size": 1024,
+        "num_classes": 50257,
+        "metric": "tokens/sec/chip (GPT-2-small, T=1024, bf16, fused tied-CE)",
+        "unit": "tokens/sec/chip",
+        "make_batch": _token_batch,
+        "example_input": _token_example,
+        "make_loss": _lm_fused_loss,
+        "items_per_row": lambda size: size,
+    },
 }
+for _cfg in BENCH_MODELS.values():
+    _cfg.setdefault("unit", "images/sec/chip")
+    _cfg.setdefault("make_batch", _image_batch)
+    _cfg.setdefault("example_input", _image_example)
+    _cfg.setdefault("make_loss", _supervised_loss)
+    _cfg.setdefault("items_per_row", lambda size: 1)
 
 
 def main():
@@ -145,26 +218,18 @@ def main():
     mesh = mesh_lib.create_mesh()
     model, flops_fn = cfg["build"](num_classes), cfg["flops"]
 
-    def criterion(logits, b):
-        loss = cross_entropy_loss(logits, b["label"])
-        return loss, {"loss": loss, "accuracy": accuracy(logits, b["label"])}
-
     engine = TrainEngine(
-        make_supervised_loss(model, criterion),
+        cfg["make_loss"](model),
         optax.sgd(0.01, momentum=0.9),
         mesh,
     )
     state = engine.init_state(
         jax.random.key(0),
-        lambda rng: model.init(rng, jnp.zeros((1, image_size, image_size, 3))),
+        lambda rng: model.init(rng, cfg["example_input"](image_size)),
     )
 
     rng = np.random.RandomState(0)
-    host_batch = {
-        "image": rng.randn(batch, image_size, image_size, 3).astype(np.float32),
-        "label": rng.randint(0, num_classes, size=(batch,)).astype(np.int32),
-    }
-    gbatch = engine.shard_batch(host_batch)
+    gbatch = engine.shard_batch(cfg["make_batch"](rng, batch, image_size, num_classes))
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.
@@ -174,7 +239,7 @@ def main():
     )
     cost = compiled.cost_analysis()
     xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
-    step_flops = flops_fn(model, image_size) * batch
+    step_flops = flops_fn(model, image_size) * batch * cfg["items_per_row"](image_size)
 
     # Warmup, then best of `windows` timed windows — the chip is shared behind
     # a relay here and external interference only ever subtracts, so the
@@ -195,7 +260,8 @@ def main():
     dt = min(per_step)
 
     n_chips = len(jax.devices())
-    images_per_sec = batch / dt
+    items = batch * cfg["items_per_row"](image_size)
+    images_per_sec = items / dt
     peak = peak_flops(jax.devices()[0]) * n_chips
     mfu = step_flops / dt / peak
     mfu_xla = xla_step_flops / dt / peak if xla_step_flops else 0.0
@@ -205,7 +271,7 @@ def main():
             {
                 "metric": cfg["metric"],
                 "value": round(images_per_sec / n_chips, 2),
-                "unit": "images/sec/chip",
+                "unit": cfg["unit"],
                 "vs_baseline": round(mfu / 0.60, 4),
                 "mfu": round(mfu, 4),
                 "mfu_xla": round(mfu_xla, 4),

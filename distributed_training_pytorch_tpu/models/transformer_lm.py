@@ -244,6 +244,33 @@ class TransformerLM(nn.Module):
         return logits
 
 
+def make_fused_lm_loss(model: TransformerLM):
+    """Engine LossFn for next-token training through the fused tied-embedding
+    CE (``ops.losses.tied_cross_entropy``) — the [B, T, V] float32 logits
+    never materialize. Batch contract: ``image`` = input tokens, ``label`` =
+    next tokens, optional ``mask`` [B] pad weights. ONE implementation shared
+    by the training entry and the benchmark so they measure the same
+    computation."""
+    from distributed_training_pytorch_tpu.ops.losses import (
+        tied_cross_entropy,
+        weighted_mean,
+    )
+
+    def loss_fn(params, model_state, batch, rng, train):
+        kwargs = {"rngs": {"dropout": rng}} if train else {}
+        hidden = model.apply(
+            {"params": params}, batch["image"], train=train, return_hidden=True, **kwargs
+        )
+        nll = tied_cross_entropy(
+            hidden, params["embed"]["embedding"], batch["label"]
+        ).mean(axis=-1)  # [B]
+        loss = weighted_mean(nll, batch.get("mask"))
+        metrics = {"loss": loss, "nll": loss, "ppl": jnp.exp(loss)}
+        return loss, (metrics, model_state)
+
+    return loss_fn
+
+
 def generate(
     model: TransformerLM,
     variables,

@@ -110,26 +110,9 @@ class LMTrainer(Trainer):
         B=32 -> 64 at T=1024, same tok/s)."""
         if os.environ.get("FUSED_CE", "1") == "0":
             return super().build_loss_fn()
-        from distributed_training_pytorch_tpu.ops.losses import (
-            tied_cross_entropy,
-            weighted_mean,
-        )
+        from distributed_training_pytorch_tpu.models.transformer_lm import make_fused_lm_loss
 
-        model = self.model
-
-        def loss_fn(params, model_state, batch, rng, train):
-            kwargs = {"rngs": {"dropout": rng}} if train else {}
-            hidden = model.apply(
-                {"params": params}, batch["image"], train=train, return_hidden=True, **kwargs
-            )
-            nll = tied_cross_entropy(
-                hidden, params["embed"]["embedding"], batch["label"]
-            ).mean(axis=-1)  # [B]
-            loss = weighted_mean(nll, batch.get("mask"))
-            metrics = {"nll": loss, "ppl": jnp.exp(loss)}
-            return loss, (metrics, model_state)
-
-        return loss_fn
+        return make_fused_lm_loss(self.model)
 
     def build_scheduler(self):
         steps_per_epoch = max(1, len(self.train_dataset) // self.batch_size)

@@ -121,17 +121,22 @@ def _build_lm(num_classes):
     return GPTSmall(dtype=jnp.bfloat16)
 
 
-def _image_batch(rng, batch, size, num_classes):
+def _image_batch(rng, batch, size, num_classes, model):
+    del model
     return {
         "image": rng.randn(batch, size, size, 3).astype(np.float32),
         "label": rng.randint(0, num_classes, size=(batch,)).astype(np.int32),
     }
 
 
-def _token_batch(rng, batch, size, num_classes):
+def _token_batch(rng, batch, size, num_classes, model):
+    # vocab comes from the built model — one source of truth (a drifted
+    # registry constant would silently clamp out-of-range ids under jit)
+    del num_classes
+    vocab = model.vocab_size
     return {
-        "image": rng.randint(0, num_classes, size=(batch, size)).astype(np.int32),
-        "label": rng.randint(0, num_classes, size=(batch, size)).astype(np.int32),
+        "image": rng.randint(0, vocab, size=(batch, size)).astype(np.int32),
+        "label": rng.randint(0, vocab, size=(batch, size)).astype(np.int32),
     }
 
 
@@ -229,7 +234,7 @@ def main():
     )
 
     rng = np.random.RandomState(0)
-    gbatch = engine.shard_batch(cfg["make_batch"](rng, batch, image_size, num_classes))
+    gbatch = engine.shard_batch(cfg["make_batch"](rng, batch, image_size, num_classes, model))
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.

@@ -97,8 +97,20 @@ def evaluate(
 
 
 if __name__ == "__main__":
+    import os
+
     checkpoint_dir = sys.argv[1] if len(sys.argv) > 1 else "./runs/weights/last"
     test_path = sys.argv[2] if len(sys.argv) > 2 else "./data/test"
-    results = evaluate(checkpoint_dir, test_path)
+    # EVAL_MODEL picks any zoo member (vgg16|resnet50|vit_b16|convnext_l...);
+    # default stays the reference's VGG16. EVAL_LABELS is a comma list.
+    labels = [s.strip() for s in os.environ.get("EVAL_LABELS", "").split(",") if s.strip()] or None
+    model = None
+    if os.environ.get("EVAL_MODEL"):
+        from distributed_training_pytorch_tpu.models import create_model
+
+        model = create_model(
+            os.environ["EVAL_MODEL"], num_classes=len(labels or LABELS)
+        )
+    results = evaluate(checkpoint_dir, test_path, labels=labels, model=model)
     print(f"ACCURACY TOP-1: {results['top1']:.4f}")
     print(f"ACCURACY TOP-2: {results['top2']:.4f}")

@@ -244,28 +244,68 @@ class TransformerLM(nn.Module):
         return logits
 
 
-def make_fused_lm_loss(model: TransformerLM):
+def make_fused_lm_loss(
+    model: TransformerLM,
+    *,
+    aux_loss_coef: float = 0.01,
+    z_loss_coef: float = 1e-3,
+):
     """Engine LossFn for next-token training through the fused tied-embedding
     CE (``ops.losses.tied_cross_entropy``) — the [B, T, V] float32 logits
     never materialize. Batch contract: ``image`` = input tokens, ``label`` =
     next tokens, optional ``mask`` [B] pad weights. ONE implementation shared
     by the training entry and the benchmark so they measure the same
-    computation."""
+    computation.
+
+    For MoE models (``moe_every > 0``) the routers' sown aux losses join the
+    objective: Switch load-balance * ``aux_loss_coef`` + router-z *
+    ``z_loss_coef`` (standard coefficients; without them routing collapses
+    onto a few experts)."""
     from distributed_training_pytorch_tpu.ops.losses import (
         tied_cross_entropy,
         weighted_mean,
     )
 
+    has_moe = model.moe_every > 0
+
     def loss_fn(params, model_state, batch, rng, train):
         kwargs = {"rngs": {"dropout": rng}} if train else {}
-        hidden = model.apply(
-            {"params": params}, batch["image"], train=train, return_hidden=True, **kwargs
-        )
+        if has_moe:
+            hidden, inter = model.apply(
+                {"params": params},
+                batch["image"],
+                train=train,
+                return_hidden=True,
+                mutable=["intermediates"],
+                **kwargs,
+            )
+        else:
+            hidden = model.apply(
+                {"params": params}, batch["image"], train=train, return_hidden=True, **kwargs
+            )
         nll = tied_cross_entropy(
             hidden, params["embed"]["embedding"], batch["label"]
         ).mean(axis=-1)  # [B]
         loss = weighted_mean(nll, batch.get("mask"))
         metrics = {"loss": loss, "nll": loss, "ppl": jnp.exp(loss)}
+        if has_moe:
+            # mean of each sown metric across the MoE blocks, selected by name
+            def collect(name):
+                vals = [
+                    v
+                    for path, v in jax.tree_util.tree_flatten_with_path(
+                        inter["intermediates"]
+                    )[0]
+                    if name in jax.tree_util.keystr(path)
+                ]
+                return jnp.mean(jnp.stack([jnp.asarray(v) for v in vals])) if vals else 0.0
+
+            lb = collect("load_balance_loss")
+            zl = collect("router_z_loss")
+            loss = loss + aux_loss_coef * lb + z_loss_coef * zl
+            metrics["moe_load_balance"] = lb
+            metrics["moe_router_z"] = zl
+            metrics["loss"] = loss
         return loss, (metrics, model_state)
 
     return loss_fn

@@ -109,7 +109,17 @@ class LMTrainer(Trainer):
         never materialize (doubles the trainable batch for GPT-small on v5e:
         B=32 -> 64 at T=1024, same tok/s)."""
         if os.environ.get("FUSED_CE", "1") == "0":
-            return super().build_loss_fn()
+            if self.moe_every > 0:
+                # the naive criterion path cannot see the routers' sown aux
+                # losses — training MoE without them collapses routing, so
+                # the toggle is ignored rather than silently degrading
+                self.log(
+                    "FUSED_CE=0 ignored: MoE models need the fused loss "
+                    "(router aux losses ride it)",
+                    "warning",
+                )
+            else:
+                return super().build_loss_fn()
         from distributed_training_pytorch_tpu.models.transformer_lm import make_fused_lm_loss
 
         return make_fused_lm_loss(self.model)

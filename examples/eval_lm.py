@@ -64,8 +64,7 @@ def evaluate(checkpoint_dir: str, corpus: str, *, size="small", seq_len=256, bat
     """Returns {"nll": mean byte NLL, "ppl": perplexity, "n_windows": N}."""
     from examples.train_lm import load_windows
 
-    os.environ["LM_CORPUS"] = corpus
-    windows = load_windows(seq_len)
+    windows = load_windows(seq_len, path=corpus)
     model, params = loaded or load_params(checkpoint_dir, size, seq_len, moe_every)
 
     @jax.jit

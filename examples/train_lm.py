@@ -32,9 +32,10 @@ from distributed_training_pytorch_tpu.utils import Logger
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
 
 
-def load_windows(seq_len: int) -> np.ndarray:
-    """[N, seq_len+1] int32 byte windows (input = [:-1], target = [1:])."""
-    path = os.environ.get("LM_CORPUS")
+def load_windows(seq_len: int, path: str | None = None) -> np.ndarray:
+    """[N, seq_len+1] int32 byte windows (input = [:-1], target = [1:]).
+    ``path`` overrides the LM_CORPUS env (offline eval passes it directly)."""
+    path = path if path is not None else os.environ.get("LM_CORPUS")
     if path:
         if not os.path.exists(path):
             # A typo'd path must not silently train on synthetic data.

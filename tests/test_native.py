@@ -127,3 +127,33 @@ def test_crop_flip_transform_in_loader_matches_direct():
     direct = t.batch_apply(imgs[:4], np.arange(4), 2)
     np.testing.assert_array_equal(batches[0]["image"], direct)
     np.testing.assert_array_equal(batches[1]["label"], np.arange(4, 8))
+
+
+def test_corrupt_payloads_raise_not_crash():
+    """Truncated/garbage JPEG and PNG payloads exercise the setjmp error
+    paths: a per-record ValueError, never a crash or leak-driven abort."""
+    from distributed_training_pytorch_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    good = io.BytesIO()
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(good, format="PNG")
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+
+    # valid magic + garbage body, for both formats
+    bad_jpeg = b"\xff\xd8" + b"\x00" * 64
+    bad_png = b"\x89PNG\r\n\x1a\n" + b"junkjunkjunk" * 4
+    truncated_png = good.getvalue()[:20]
+
+    for bad in (bad_jpeg, bad_png, truncated_png):
+        with pytest.raises(ValueError, match="failed to decode"):
+            native.decode_resize_normalize_bytes([good.getvalue(), bad], 8, 8, mean, std)
+    # and the good payload still decodes fine afterwards (no corrupted state)
+    out = native.decode_resize_normalize_bytes([good.getvalue()], 8, 8, mean, std)
+    assert out.shape == (1, 8, 8, 3)

@@ -1,0 +1,44 @@
+"""utils/profiling: trace capture + headless xplane parsing (SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_pytorch_tpu.utils import profiling
+
+
+def test_trace_writes_xplane_and_parser_reads_it(tmp_path):
+    with profiling.trace(str(tmp_path)):
+        with profiling.annotate("tiny_matmul"):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    path = profiling.latest_trace_file(str(tmp_path))
+    assert path is not None and path.endswith(".xplane.pb")
+    # On the CPU test platform there are no TPU/GPU device planes, so the op
+    # table is empty — but the wire-format parse itself must succeed.
+    ops = profiling.top_ops(str(tmp_path))
+    assert isinstance(ops, list)
+    for name, total_us, count in ops:
+        assert isinstance(name, str) and total_us >= 0 and count >= 1
+
+
+def test_top_ops_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profiling.top_ops(str(tmp_path / "nope"))
+
+
+def test_varint_fields_roundtrip():
+    """The hand-rolled protobuf reader handles all wire types it claims."""
+    # field 1 varint=300, field 2 bytes"abc", field 3 fixed32, field 4 fixed64
+    buf = (
+        b"\x08\xac\x02"  # 1<<3|0, varint 300
+        b"\x12\x03abc"  # 2<<3|2, len 3
+        b"\x1d\x01\x00\x00\x00"  # 3<<3|5
+        b"\x21\x02\x00\x00\x00\x00\x00\x00\x00"  # 4<<3|1
+    )
+    fields = list(profiling._fields(buf))
+    assert fields[0] == (1, 0, 300)
+    assert fields[1] == (2, 2, b"abc")
+    assert fields[2][0] == 3 and len(fields[2][2]) == 4
+    assert fields[3][0] == 4 and len(fields[3][2]) == 8

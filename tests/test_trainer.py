@@ -248,3 +248,32 @@ def test_tensorboard_writer_emits_events(tmp_path, mesh):
     # Tags are embedded as plain strings in the event protos.
     assert b"train/ce_loss" in payload
     assert b"val/accuracy" in payload
+
+
+def test_build_loss_fn_hook_override(tmp_path, mesh):
+    """The advanced loss hook replaces the model+criterion composition (the
+    fused-CE path in examples/train_lm.py relies on this contract)."""
+    calls = []
+
+    class CustomLoss(ToyTrainer):
+        def build_loss_fn(self):
+            model = self.model
+
+            def loss_fn(params, model_state, batch, rng, train):
+                calls.append(train)
+                logits = model.apply(
+                    {"params": params}, batch["image"], train=train,
+                    **({"rngs": {"dropout": rng}} if train else {}),
+                )
+                loss = cross_entropy_loss(logits, batch["label"])
+                return loss, ({"custom_loss": loss}, model_state)
+
+            return loss_fn
+
+    trainer = make_trainer(
+        tmp_path, mesh, cls=CustomLoss, max_epoch=1,
+        have_validate=False, save_best_for=None, save_period=None,
+    )
+    metrics = trainer.train_epoch(0)
+    assert calls, "custom loss_fn never traced"
+    assert "custom_loss" in metrics and np.isfinite(metrics["custom_loss"])

@@ -47,6 +47,7 @@ class ShardedLoader:
         shuffle: bool = True,
         seed: int = 0,
         transform: Optional[Callable] = None,
+        collate_fn: Optional[Callable] = None,
         num_workers: int = 8,
         drop_last: bool = True,
         pad_final: bool = False,
@@ -56,6 +57,15 @@ class ShardedLoader:
         if drop_last and pad_final:
             raise ValueError("drop_last and pad_final are mutually exclusive")
         self.source = source
+        # Ref-parity extension point: the reference ctor forwards
+        # ``dataset.collate_fn`` to DataLoader (``trainer/trainer.py:59-71``).
+        # A collate takes the list of transformed records and returns the
+        # batch dict — required when records carry ragged/non-stackable
+        # fields. Explicit arg wins; else the source's attribute; else the
+        # default field-wise np.stack.
+        self.collate_fn = collate_fn if collate_fn is not None else getattr(
+            source, "collate_fn", None
+        )
         self.global_batch_size = int(global_batch_size)
         self.shuffle = shuffle
         self.seed = seed
@@ -105,6 +115,11 @@ class ShardedLoader:
         """Whole-batch production in one call (native C++ runtime): either the
         source loads batches itself (``load_batch``), or it exposes in-memory
         ``arrays`` and the transform is batch-capable (``batch_apply``)."""
+        if self.collate_fn is not None:
+            # Custom collate implies per-record production — the batch fast
+            # paths stack fields themselves, which is exactly what a custom
+            # collate exists to replace.
+            return None
         if hasattr(self.source, "load_batch"):
             return "source"
         if (
@@ -124,14 +139,19 @@ class ShardedLoader:
                 batch["image"] = self.transform.batch_apply(batch["image"], rows, epoch)
         else:
             records = [self._load_one(i, epoch) for i in rows]
-            batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
+            return self._collate(records, mask)
         if mask is not None:
             batch["mask"] = mask
         return batch
 
     def _collate(self, records: list[dict], mask: np.ndarray | None) -> dict:
-        batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
+        if self.collate_fn is not None:
+            batch = dict(self.collate_fn(records))
+        else:
+            batch = {k: np.stack([r[k] for r in records]) for k in records[0]}
         if mask is not None:
+            # The pad mask stays loader-owned even under a custom collate:
+            # padded-row weighting is a loader invariant, not a collate concern.
             batch["mask"] = mask
         return batch
 

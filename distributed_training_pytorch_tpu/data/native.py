@@ -25,7 +25,7 @@ import numpy as np
 
 _LIB_NAME = "libdtp_native.so"
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
-_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "csrc")
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "csrc")
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -178,7 +178,11 @@ def _bwd_dkv_kernel(
         if causal:
             mask = jnp.logical_and(mask, q_idx >= k_idx)
         s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]; padded q rows have lse=0, p=exp(NEG_INF)=0
+        # [bq, bk]. Padded q rows (zero q, zero-padded lse) give s=0, lse=0,
+        # p=1 — NOT p=0. Their dv/dk contributions still vanish only because
+        # dO and delta are zero-padded (dv += p^T·dO = 0; ds = p*(dp-delta)
+        # has dp = dO·v^T = 0 and delta = 0). Keep the dO/delta zero-padding.
+        p = jnp.exp(s - lse)
         dv_new = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bk, D]

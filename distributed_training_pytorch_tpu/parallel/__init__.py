@@ -21,7 +21,9 @@ from distributed_training_pytorch_tpu.parallel.sharding import (  # noqa: F401
 )
 from distributed_training_pytorch_tpu.parallel.pipeline import (  # noqa: F401
     PIPE_AXIS,
+    bubble_fraction,
     pipeline_apply,
+    schedule_stats,
     stack_stage_params,
 )
 from distributed_training_pytorch_tpu.parallel.moe import (  # noqa: F401

@@ -32,12 +32,17 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, in mesh order. `data` is outermost so that pure-DP
-# meshes are contiguous over ICI and cross-host traffic stays on the data axis.
+# meshes are contiguous over ICI and cross-host traffic stays on the data axis;
+# `pipe` sits just inside it (stage-to-stage ppermute tolerates DCN hops),
+# while `seq`/`tensor` are innermost so their latency-sensitive collectives
+# (ring permutes, all-reduces) ride contiguous ICI neighborhoods.
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 TENSOR_AXIS = "tensor"
 SEQ_AXIS = "seq"
-AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, TENSOR_AXIS)
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
 
 _initialized = False
 
@@ -135,8 +140,11 @@ def create_mesh(
     total = int(np.prod(list(axes.values())))
     if total != n:
         raise ValueError(f"mesh {axes} needs {total} devices, have {n}")
+    unknown = [a for a in axes if a not in AXIS_ORDER]
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; known axes are {AXIS_ORDER}")
     # Canonical ordering keeps `data` outermost regardless of dict order.
-    names = sorted(axes, key=lambda a: AXIS_ORDER.index(a) if a in AXIS_ORDER else 99)
+    names = sorted(axes, key=AXIS_ORDER.index)
     shape = tuple(axes[name] for name in names)
     device_array = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(device_array, axis_names=tuple(names))
@@ -189,15 +197,20 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
+    expert: int = 1
     seq: int = 1
     tensor: int = 1
 
     def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
         axes = {DATA_AXIS: self.data}
-        if self.fsdp != 1:
-            axes[FSDP_AXIS] = self.fsdp
-        if self.seq != 1:
-            axes[SEQ_AXIS] = self.seq
-        if self.tensor != 1:
-            axes[TENSOR_AXIS] = self.tensor
+        for name, size in (
+            (FSDP_AXIS, self.fsdp),
+            (PIPE_AXIS, self.pipe),
+            (EXPERT_AXIS, self.expert),
+            (SEQ_AXIS, self.seq),
+            (TENSOR_AXIS, self.tensor),
+        ):
+            if size != 1:
+                axes[name] = size
         return create_mesh(axes, devices=devices)

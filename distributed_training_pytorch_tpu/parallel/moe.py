@@ -28,9 +28,7 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from distributed_training_pytorch_tpu.parallel.mesh import DATA_AXIS
-
-EXPERT_AXIS = "expert"
+from distributed_training_pytorch_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
 
 __all__ = ["EXPERT_AXIS", "MoEMlp", "load_balance_loss", "router_z_loss"]
 

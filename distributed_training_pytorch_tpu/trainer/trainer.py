@@ -219,6 +219,8 @@ class Trainer:
             shuffle=train,
             seed=self.seed,
             transform=getattr(dataset, "transform", None),
+            # dataset.collate_fn (ref trainer/trainer.py:59-71) is picked up
+            # by the ShardedLoader ctor's own fallback.
             num_workers=self.num_workers,
             drop_last=train,
             pad_final=not train,
@@ -240,6 +242,7 @@ class Trainer:
     def train(self) -> None:
         """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
         self._install_sigterm()
+        self.metrics_writer.reopen()  # symmetric with the close() below
         try:
             self._train_loop()
         finally:

@@ -21,14 +21,21 @@ class MetricsWriter:
     """Scalar writer: ``writer.write(step, {"loss": ...}, prefix="train")``."""
 
     def __init__(self, log_dir: str | None):
+        self._log_dir = log_dir
         self._writer = None
-        if log_dir and jax.process_index() == 0:
-            try:
-                from tensorboardX import SummaryWriter
+        self.reopen()
 
-                self._writer = SummaryWriter(log_dir)
-            except ImportError:
-                pass  # stay a no-op; console/file logging still covers metrics
+    def reopen(self) -> None:
+        """(Re)create the backend writer — lets a closed writer come back for a
+        re-entered ``train()`` instead of silently dropping all later scalars."""
+        if self._writer is not None or not self._log_dir or jax.process_index() != 0:
+            return
+        try:
+            from tensorboardX import SummaryWriter
+
+            self._writer = SummaryWriter(self._log_dir)
+        except ImportError:
+            pass  # stay a no-op; console/file logging still covers metrics
 
     @property
     def active(self) -> bool:

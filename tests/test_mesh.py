@@ -44,3 +44,20 @@ def test_local_batch_size_single_process(devices):
 def test_mesh_config(devices):
     mesh = mesh_lib.MeshConfig(data=-1, tensor=2).build()
     assert mesh.shape == {"data": 4, "tensor": 2}
+
+
+def test_mesh_unknown_axis_rejected(devices):
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        mesh_lib.create_mesh({"data": -1, "modle": 2})
+
+
+def test_full_six_axis_mesh(devices):
+    # Every canonical axis at once (sizes 2,2,2,1,1,1 over the 8-device CPU
+    # mesh); declarative config and direct build agree on canonical order.
+    mesh = mesh_lib.MeshConfig(data=2, fsdp=2, pipe=2, expert=1, seq=1, tensor=1).build()
+    assert mesh.axis_names == ("data", "fsdp", "pipe")
+    full = mesh_lib.create_mesh(
+        {"tensor": 1, "seq": 1, "expert": 2, "pipe": 1, "fsdp": 2, "data": -1}
+    )
+    assert full.axis_names == ("data", "fsdp", "pipe", "expert", "seq", "tensor")
+    assert full.shape == {"data": 2, "fsdp": 2, "pipe": 1, "expert": 2, "seq": 1, "tensor": 1}

@@ -157,6 +157,7 @@ class CheckpointManager:
         """
         self.wait()  # an in-flight async save only becomes visible once committed
         path = self.path(name_or_path) if os.sep not in name_or_path else name_or_path
+        path = os.path.abspath(path)  # orbax rejects relative paths
         if not os.path.isdir(path):
             raise FileNotFoundError(f"no checkpoint at {path}")
         if os.path.isdir(os.path.join(path, "state")):

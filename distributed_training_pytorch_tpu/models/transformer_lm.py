@@ -74,6 +74,8 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
     num_experts: int = 8
     moe_num_groups: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_impl: str = "einsum"
     max_len: int = 2048
 
     @nn.compact
@@ -98,16 +100,6 @@ class DecoderBlock(nn.Module):
         if decode:
             if x.shape[1] != 1:
                 raise ValueError(f"decode mode consumes one token at a time, got T={x.shape[1]}")
-            if self.use_moe:
-                # Per-step routing sees B tokens with a tiny per-step capacity
-                # — silently different drop behavior than the training-time
-                # forward (which routes B*T tokens). Refuse rather than
-                # diverge quietly; decode for MoE LMs needs a dedicated
-                # inference router.
-                raise NotImplementedError(
-                    "KV-cache decode through MoE blocks is not supported; "
-                    "use a dense model (moe_every=0) for generation"
-                )
             if decode_index is None:
                 raise ValueError("decode=True requires decode_index (the model's step counter)")
             b = x.shape[0]
@@ -142,13 +134,18 @@ class DecoderBlock(nn.Module):
 
         y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.use_moe:
+            # Decode routes capacity-free (per-token expert gather — no
+            # buffers, no drops), so KV-cache generation works for MoE LMs
+            # with the same parameters the capacity-routed training saved.
             y = MoEMlp(
                 num_experts=self.num_experts,
                 hidden_dim=self.mlp_dim,
                 num_groups=self.moe_num_groups,
+                capacity_factor=self.moe_capacity_factor,
+                dispatch_impl=self.moe_dispatch_impl,
                 dtype=self.dtype,
                 name="moe",
-            )(y)
+            )(y, decode=decode)
         else:
             y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(y)
             y = nn.gelu(y)
@@ -176,6 +173,8 @@ class TransformerLM(nn.Module):
     moe_every: int = 0
     num_experts: int = 8
     moe_num_groups: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_impl: str = "einsum"
     tie_embeddings: bool = True
 
     @nn.compact
@@ -228,6 +227,8 @@ class TransformerLM(nn.Module):
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 num_experts=self.num_experts,
                 moe_num_groups=self.moe_num_groups,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_dispatch_impl=self.moe_dispatch_impl,
                 max_len=self.max_len,
             )(x, train=train, decode=decode, decode_index=decode_index)
         x = nn.LayerNorm(dtype=self.dtype)(x)

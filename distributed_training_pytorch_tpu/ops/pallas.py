@@ -215,24 +215,18 @@ def _from_bhtd(x):
     return jnp.transpose(x, (0, 2, 1, 3))
 
 
-def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
-    b, t, h, d = q.shape
-    scale = d**-0.5
-    bq = min(block_q, max(t, 1))
-    bk = min(block_k, max(t, 1))
-    tq_pad = pl.cdiv(t, bq) * bq
-    tk_pad = pl.cdiv(t, bk) * bk
-    qt = _pad_to(_to_bhtd(q), tq_pad, 2)
-    kt = _pad_to(_to_bhtd(k), tk_pad, 2)
-    vt = _pad_to(_to_bhtd(v), tk_pad, 2)
-    n_q = tq_pad // bq
-
+def _fwd_call(qt, kt, vt, t_k, causal, bq, bk, interpret):
+    """Forward pallas call on padded [B, H, T*, D] operands -> (o, lse) in the
+    padded layout. Shared by flash_attention (square T) and the ring block
+    path (Tq from the resident shard, Tk from the visiting block)."""
+    b, h, tq_pad, d = qt.shape
+    tk_pad = kt.shape[2]
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_k=bk, seq_len=t, causal=causal
+        _fwd_kernel, scale=d**-0.5, block_k=bk, seq_len=t_k, causal=causal
     )
-    o, lse = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(b, h, n_q),
+        grid=(b, h, tq_pad // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
@@ -243,50 +237,32 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, 1, bq), lambda bi, hi, qi: (bi, hi, 0, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq_pad, d), qt.dtype),
             jax.ShapeDtypeStruct((b, h, 1, tq_pad), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    qt, kt, vt, bq, bk = _ring_pad(q, k, v, block_q, block_k)
+    o, lse = _fwd_call(qt, kt, vt, t, causal, bq, bk, interpret)
     return o[:, :, :t, :], lse[:, :, :, :t], (qt, kt, vt)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return _from_bhtd(o)
-
-
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse, (qt, kt, vt) = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return _from_bhtd(o), (qt, kt, vt, o, lse, q.shape)
-
-
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    qt, kt, vt, o, lse, q_shape = res
-    b, t, h, d = q_shape
-    scale = d**-0.5
-    bq = min(block_q, max(t, 1))
-    bk = min(block_k, max(t, 1))
-    tq_pad = qt.shape[2]
+def _dq_call(qt, kt, vt, do, lse_p, delta, t_q, t_k, causal, bq, bk, interpret):
+    """dq pallas call on padded [B, H, T*, D] operands. ``t_k`` masks padded
+    K rows; ``t_q`` is unused by the kernel (padded q rows produce garbage dq
+    rows that callers slice off) but kept for call-site clarity."""
+    b, h, tq_pad, d = qt.shape
     tk_pad = kt.shape[2]
-    n_q = tq_pad // bq
-    n_k = tk_pad // bk
-
-    do = _pad_to(_to_bhtd(g), tq_pad, 2)
-    # delta_i = rowsum(dO_i * O_i) — tiny elementwise precompute, plain XLA.
-    delta = jnp.sum(
-        do[:, :, :, :].astype(jnp.float32) * _pad_to(o, tq_pad, 2).astype(jnp.float32),
-        axis=-1,
-    )[:, :, None, :]  # [B, H, 1, Tq_pad]
-    lse_p = _pad_to(lse, tq_pad, 3)
-
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, block_k=bk, seq_len=t, causal=causal
+        _bwd_dq_kernel, scale=d**-0.5, block_k=bk, seq_len=t_k, causal=causal
     )
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         dq_kernel,
-        grid=(b, h, n_q),
+        grid=(b, h, tq_pad // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, tk_pad, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
@@ -300,12 +276,19 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
         interpret=interpret,
     )(qt, kt, vt, do, lse_p, delta)
 
+
+def _dkv_call(qt, kt, vt, do, lse_p, delta, t_q, t_k, causal, bq, bk, interpret):
+    """dk/dv pallas call on padded [B, H, T*, D] operands. Padded q rows are
+    harmless because ``do``/``delta`` are zero-padded (see _bwd_dkv_kernel);
+    ``t_k`` masks padded K rows."""
+    b, h, tq_pad, d = qt.shape
+    tk_pad = kt.shape[2]
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, block_q=bq, seq_len=t, causal=causal
+        _bwd_dkv_kernel, scale=d**-0.5, block_q=bq, seq_len=t_k, causal=causal
     )
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, n_k),
+        grid=(b, h, tk_pad // bk),
         in_specs=[
             pl.BlockSpec((1, 1, tq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
@@ -325,6 +308,36 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
         interpret=interpret,
     )(qt, kt, vt, do, lse_p, delta)
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return _from_bhtd(o)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse, (qt, kt, vt) = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return _from_bhtd(o), (qt, kt, vt, o, lse, q.shape)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    qt, kt, vt, o, lse, q_shape = res
+    b, t, h, d = q_shape
+    bq = min(block_q, max(t, 1))
+    bk = min(block_k, max(t, 1))
+    tq_pad = qt.shape[2]
+
+    do = _pad_to(_to_bhtd(g), tq_pad, 2)
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise precompute, plain XLA.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * _pad_to(o, tq_pad, 2).astype(jnp.float32),
+        axis=-1,
+    )[:, :, None, :]  # [B, H, 1, Tq_pad]
+    lse_p = _pad_to(lse, tq_pad, 3)
+
+    dq = _dq_call(qt, kt, vt, do, lse_p, delta, t, t, causal, bq, bk, interpret)
+    dk, dv = _dkv_call(qt, kt, vt, do, lse_p, delta, t, t, causal, bq, bk, interpret)
+
     return (
         _from_bhtd(dq[:, :, :t, :]),
         _from_bhtd(dk[:, :, :t, :]),
@@ -333,6 +346,69 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Block-level entry points for ring attention (parallel.ring_attention)
+# ---------------------------------------------------------------------------
+#
+# The ring path differentiates at the RING level (one custom VJP around the
+# whole rotation schedule), so these wrappers are plain functions: the forward
+# returns the per-block (normalized o, lse) the online merge consumes, and the
+# backward wrappers compute one block's dq / dk/dv contributions given the
+# *global* lse/delta of the resident q shard — exactly the flash
+# decomposition, applied blockwise across devices. All take/return
+# ``[B, T, H, D]`` (lse/delta ``[B, H, T]``).
+
+
+def _ring_pad(q, k, v, block_q, block_k):
+    tq, tk = q.shape[1], k.shape[1]
+    bq = min(block_q, max(tq, 1))
+    bk = min(block_k, max(tk, 1))
+    qt = _pad_to(_to_bhtd(q), pl.cdiv(tq, bq) * bq, 2)
+    kt = _pad_to(_to_bhtd(k), pl.cdiv(tk, bk) * bk, 2)
+    vt = _pad_to(_to_bhtd(v), pl.cdiv(tk, bk) * bk, 2)
+    return qt, kt, vt, bq, bk
+
+
+def flash_block_fwd(
+    q, k, v, *, causal=False,
+    block_q=_DEFAULT_BLOCK_Q, block_k=_DEFAULT_BLOCK_K, interpret=None,
+):
+    """One (q-shard x k/v-block) flash pass -> ``(o, lse)``; o is
+    block-normalized, lse = log-sum-exp of this block's logits per q row
+    (what the cross-block online merge needs). Not differentiable — the ring
+    owns the VJP."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tq, tk = q.shape[1], k.shape[1]
+    qt, kt, vt, bq, bk = _ring_pad(q, k, v, block_q, block_k)
+    o, lse = _fwd_call(qt, kt, vt, tk, causal, bq, bk, interpret)
+    return _from_bhtd(o[:, :, :tq, :]), lse[:, :, 0, :tq]
+
+
+def flash_block_bwd(
+    q, k, v, do, lse, delta, *, causal=False,
+    block_q=_DEFAULT_BLOCK_Q, block_k=_DEFAULT_BLOCK_K, interpret=None,
+):
+    """One block's backward contributions ``(dq, dk, dv)`` given the global
+    ``lse``/``delta`` ``[B, H, Tq]`` of the resident q shard."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qt, kt, vt, bq, bk = _ring_pad(q, k, v, block_q, block_k)
+    tq_pad = qt.shape[2]
+    dot = _pad_to(_to_bhtd(do), tq_pad, 2)
+    lse_p = _pad_to(lse[:, :, None, :], tq_pad, 3)
+    delta_p = _pad_to(delta[:, :, None, :], tq_pad, 3)
+    dq = _dq_call(qt, kt, vt, dot, lse_p, delta_p, tq, tk, causal, bq, bk, interpret)
+    dk, dv = _dkv_call(qt, kt, vt, dot, lse_p, delta_p, tq, tk, causal, bq, bk, interpret)
+    return (
+        _from_bhtd(dq[:, :, :tq, :]),
+        _from_bhtd(dk[:, :, :tk, :]),
+        _from_bhtd(dv[:, :, :tk, :]),
+    )
 
 
 def flash_attention(

@@ -14,6 +14,21 @@ an expert's capacity are dropped for that choice (their other choice and the
 residual path still carry them) — deterministic, order-based priority, first
 choice before second. ``capacity_factor`` sizes the buffers.
 
+Two dispatch implementations with identical routing semantics (parity-tested):
+
+* ``dispatch_impl="einsum"`` — GShard one-hot dispatch/combine tensors
+  ``[S/G, E, C]``; O((S/G)^2)-ish construction per group, all dense algebra.
+  Best at small group sizes (the one-hots stay tiny and everything fuses).
+* ``dispatch_impl="sort"`` — argsort/cummax ranking + scatter-add into the
+  ``[E, C, d]`` buffers and gather back; memory and compute O(S·k + E·C·d)
+  per group, no quadratic one-hots. Best at large group sizes. The measured
+  single-chip crossover is recorded in BASELINE.md (``bench.py`` moe mode).
+
+Inference: ``__call__(x, decode=True)`` routes capacity-free — every token
+computes its top-k experts by direct weight gather (no buffers, no drops), the
+standard MoE decode policy; identical parameters, so training checkpoints
+serve decode unchanged.
+
 Aux losses follow Switch/GShard: ``load_balance_loss`` (mean gate fraction x
 mean dispatch fraction per expert, scaled by E) and ``router_z_loss``.
 """
@@ -87,26 +102,64 @@ class MoEMlp(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     num_groups: int = 1
+    dispatch_impl: str = "einsum"
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, *, decode: bool = False) -> jax.Array:
         orig_shape = x.shape
         d = orig_shape[-1]
         tokens = x.reshape(-1, d)  # [S, d]
         s = tokens.shape[0]
         e = self.num_experts
         g = self.num_groups
-        if s % g:
-            raise ValueError(f"{s} tokens not divisible by num_groups={g}")
-        sg = s // g
-        capacity = max(1, int(np.ceil(sg * self.top_k / e * self.capacity_factor)))
+        if self.dispatch_impl not in ("einsum", "sort"):
+            raise ValueError(f"dispatch_impl must be einsum|sort, got {self.dispatch_impl!r}")
 
         # --- router (float32 for stable softmax) ---------------------------
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
             tokens.astype(jnp.float32)
         )  # [S, E]
         gates = jax.nn.softmax(logits, axis=-1)
+
+        # --- expert weights (expert-sharded) --------------------------------
+        w_in = self.param(
+            "w_in",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, d, self.hidden_dim),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
+            (e, self.hidden_dim, d),
+            jnp.float32,
+        )
+        w_in = _constrain(w_in, (EXPERT_AXIS,)).astype(self.dtype)
+        w_out = _constrain(w_out, (EXPERT_AXIS,)).astype(self.dtype)
+
+        if decode:
+            # Capacity-free inference routing: gather each token's top-k
+            # expert weights and apply them directly — no buffers, no drops,
+            # so per-step behavior matches training-renormalized gating
+            # whenever training had capacity headroom. S is tiny at decode
+            # (one token per sequence), so the [S, k, d, h] gather is cheap.
+            gate_vals, choice = jax.lax.top_k(gates, self.top_k)  # [S, k]
+            weights = gate_vals / jnp.maximum(
+                gate_vals.sum(-1, keepdims=True), 1e-9
+            )
+            tk = tokens.astype(self.dtype)
+            # jnp.take, not w_in[choice]: callers may pass host (numpy)
+            # params outside jit, and numpy fancy-indexing rejects tracers.
+            h = jax.nn.gelu(jnp.einsum("sd,skdh->skh", tk, jnp.take(w_in, choice, axis=0)))
+            y = jnp.einsum("skh,skhd->skd", h, jnp.take(w_out, choice, axis=0))
+            out = jnp.einsum("sk,skd->sd", weights.astype(self.dtype), y)
+            return out.reshape(orig_shape).astype(self.dtype)
+
+        if s % g:
+            raise ValueError(f"{s} tokens not divisible by num_groups={g}")
+        sg = s // g
+        capacity = max(1, int(np.ceil(sg * self.top_k / e * self.capacity_factor)))
 
         # --- per-group top-k routing with order-based capacity --------------
         # Choices claim capacity in priority order (choice 0 of every token in
@@ -143,8 +196,64 @@ class MoEMlp(nn.Module):
             combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
             return dispatch, combine, first_choice
 
+        # Same routing semantics, scatter/gather instead of one-hot algebra:
+        # rank each (choice, token) entry within its expert by a stable sort
+        # (choice-major flattening preserves the GShard priority order), drop
+        # ranks past capacity into a trash row, scatter-add into the [E, C, d]
+        # buffers, and gather back weighted for the combine. No [sg, E, C]
+        # tensors anywhere — O(sg*k) routing + O(E*C*d) buffers per group.
+        n_flat = self.top_k * sg
+        token_idx = jnp.tile(jnp.arange(sg), self.top_k)  # choice-major
+
+        def route_sort(group_gates, group_tokens):
+            gate_vals, choice = jax.lax.top_k(group_gates, self.top_k)  # [sg, k]
+            ex_flat = choice.T.reshape(-1)  # [k*sg], choice-major
+            order = jnp.argsort(ex_flat, stable=True)
+            sorted_ex = ex_flat[order]
+            arange = jnp.arange(n_flat)
+            run_begin = jnp.where(
+                jnp.concatenate([jnp.ones((1,), bool), sorted_ex[1:] != sorted_ex[:-1]]),
+                arange,
+                0,
+            )
+            pos_sorted = arange - jax.lax.cummax(run_begin)
+            pos = jnp.zeros((n_flat,), jnp.int32).at[order].set(pos_sorted)
+            keep = pos < capacity
+            keep_tk = keep.reshape(self.top_k, sg).T  # [sg, k]
+            gate_kept = gate_vals * keep_tk
+            weight_tk = gate_kept / jnp.maximum(gate_kept.sum(-1, keepdims=True), 1e-9)
+            rows = jnp.where(keep, ex_flat * capacity + pos, e * capacity)  # trash row
+            buf = jnp.zeros((e * capacity + 1, d), self.dtype)
+            buf = buf.at[rows].add(group_tokens.astype(self.dtype)[token_idx])
+            expert_in = buf[:-1].reshape(e, capacity, d)
+            first_choice = jax.nn.one_hot(choice[:, 0], e, dtype=jnp.int32)
+            return expert_in, rows, weight_tk.T.reshape(-1), first_choice
+
+        def combine_sort(expert_out, rows, w_flat):
+            flat = expert_out.reshape(e * capacity, d)
+            picked = flat[jnp.clip(rows, 0, e * capacity - 1)]
+            picked = picked * (rows < e * capacity)[:, None]
+            contrib = picked * w_flat.astype(self.dtype)[:, None]
+            return jnp.zeros((sg, d), self.dtype).at[token_idx].add(contrib)
+
         grouped_gates = gates.reshape(g, sg, e)
-        dispatch, combine, first_choice = jax.vmap(route)(grouped_gates)
+        # The reshard from token-sharded [G over data] to expert-sharded IS
+        # the all-to-all (inserted by the SPMD partitioner at the constraint).
+        grouped_tokens = tokens.reshape(g, sg, d)
+        grouped_tokens = _constrain(grouped_tokens, (DATA_AXIS,))
+
+        if self.dispatch_impl == "sort":
+            expert_in, rows, w_flat, first_choice = jax.vmap(route_sort)(
+                grouped_gates, grouped_tokens
+            )
+        else:
+            dispatch, combine, first_choice = jax.vmap(route)(grouped_gates)
+            # dispatch: [G, sg, E, C] x [G, sg, d] -> [G, E, C, d]
+            expert_in = jnp.einsum(
+                "gsec,gsd->gecd",
+                dispatch.astype(self.dtype),
+                grouped_tokens.astype(self.dtype),
+            )
 
         self.sow(
             "intermediates",
@@ -154,32 +263,13 @@ class MoEMlp(nn.Module):
         self.sow("intermediates", "router_z_loss", router_z_loss(logits))
 
         # --- expert computation (expert-sharded) ---------------------------
-        w_in = self.param(
-            "w_in",
-            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
-            (e, d, self.hidden_dim),
-            jnp.float32,
-        )
-        w_out = self.param(
-            "w_out",
-            nn.initializers.variance_scaling(2.0, "fan_in", "truncated_normal"),
-            (e, self.hidden_dim, d),
-            jnp.float32,
-        )
-        w_in = _constrain(w_in, (EXPERT_AXIS,)).astype(self.dtype)
-        w_out = _constrain(w_out, (EXPERT_AXIS,)).astype(self.dtype)
-
-        # dispatch: [G, sg, E, C] x [G, sg, d] -> [G, E, C, d]; the reshard
-        # from token-sharded [G over data] to expert-sharded IS the all-to-all.
-        grouped_tokens = tokens.reshape(g, sg, d)
-        grouped_tokens = _constrain(grouped_tokens, (DATA_AXIS,))
-        expert_in = jnp.einsum(
-            "gsec,gsd->gecd", dispatch.astype(self.dtype), grouped_tokens.astype(self.dtype)
-        )
         expert_in = _constrain(expert_in, (DATA_AXIS, EXPERT_AXIS))
         h = jax.nn.gelu(jnp.einsum("gecd,edh->gech", expert_in, w_in))
         expert_out = jnp.einsum("gech,ehd->gecd", h, w_out)
         expert_out = _constrain(expert_out, (DATA_AXIS, EXPERT_AXIS))
 
-        out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), expert_out)
+        if self.dispatch_impl == "sort":
+            out = jax.vmap(combine_sort)(expert_out, rows, w_flat)
+        else:
+            out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), expert_out)
         return out.reshape(orig_shape).astype(self.dtype)

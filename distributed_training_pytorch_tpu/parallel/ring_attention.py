@@ -58,10 +58,41 @@ def ring_attention(
     *,
     axis: str = SEQ_AXIS,
     causal: bool = False,
+    impl: str = "auto",
 ) -> jax.Array:
-    """Exact attention over a sequence sharded on ``axis``. [B, T, H, D]."""
+    """Exact attention over a sequence sharded on ``axis``. [B, T, H, D].
+
+    ``impl``:
+
+    * ``"dense"`` — each ring step materializes the [B, H, Tq, Tk] block
+      logits (fine for moderate per-shard T; O(T_local^2) memory).
+    * ``"flash"`` — each ring step runs the Pallas flash kernel on the
+      visiting K/V block and merges via the kernel's LSE statistics, so
+      per-shard memory stays O(T_local) and the [Tq, Tk] scores never exist.
+      Under a causal mask, fully-masked blocks (owner > self) skip the kernel
+      outright — about half the ring FLOPs, which the dense path spends on
+      fully-bias-masked matmuls. Backward is the blockwise flash
+      decomposition run as a reverse ring (dk/dv accumulate on the rotating
+      blocks; one ring-level custom VJP owns the schedule).
+    * ``"auto"`` — flash on TPU when the per-shard sequence clears the
+      kernel's measured crossover (``ops.pallas.FLASH_MIN_SEQ_LEN``), else
+      dense.
+    """
     if axis not in mesh.shape:
         raise ValueError(f"mesh has no axis {axis!r}")
+    if impl not in ("auto", "dense", "flash"):
+        raise ValueError(f"impl must be auto|dense|flash, got {impl!r}")
+    if impl == "auto":
+        from distributed_training_pytorch_tpu.ops.pallas import FLASH_MIN_SEQ_LEN
+
+        t_local = q.shape[1] // mesh.shape[axis]
+        impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and t_local >= FLASH_MIN_SEQ_LEN
+            else "dense"
+        )
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, mesh, axis=axis, causal=causal)
     scale = q.shape[-1] ** -0.5
 
     def kernel(q, k, v):
@@ -112,6 +143,172 @@ def ring_attention(
     return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
+
+
+def _ring_attention_flash(q, k, v, mesh, *, axis, causal):
+    """Ring attention with the Pallas flash kernel as the per-block compute.
+
+    Forward: each device keeps its q shard; K/V blocks rotate; every step
+    runs ``flash_block_fwd`` (block-normalized output + LSE) and merges into
+    the running output with ``logaddexp`` weights — the online softmax across
+    blocks, with the within-block online softmax living in the kernel.
+
+    Backward: the standard blockwise flash decomposition, run as a second
+    ring. ``delta = rowsum(dO * O)`` and the final LSE are global per-q-row
+    statistics, so each visiting K/V block's (dq, dk, dv) contributions are
+    computable locally by the flash backward kernels; dq accumulates in place
+    while dk/dv accumulate on buffers that rotate *with* their K/V blocks and
+    arrive home after a full loop. A custom VJP around the two shard_maps
+    owns the schedule (autodiff never sees the kernel internals).
+    """
+    s = mesh.shape[axis]
+    interpret = jax.default_backend() != "tpu"
+    from distributed_training_pytorch_tpu.ops.pallas import (
+        flash_block_bwd,
+        flash_block_fwd,
+    )
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def block_type(step):
+        # Causal block classification: the visiting block left its owner
+        # `step` hops back. 0 = fully masked (skip), 1 = diagonal (local
+        # causal), 2 = fully visible.
+        my = lax.axis_index(axis)
+        owner = (my - step) % s
+        return jnp.where(owner == my, 1, jnp.where(owner < my, 2, 0))
+
+    def fwd_kernel(q, k, v):
+        b, tl, h, d = q.shape
+
+        def fwd_block(step, k_blk, v_blk):
+            if not causal:
+                return flash_block_fwd(q, k_blk, v_blk, causal=False, interpret=interpret)
+
+            def skip(_k, _v):
+                return (
+                    jnp.zeros((b, tl, h, d), q.dtype),
+                    jnp.full((b, h, tl), _NEG_INF, jnp.float32),
+                )
+
+            return lax.switch(
+                block_type(step),
+                [
+                    skip,
+                    lambda kb, vb: flash_block_fwd(q, kb, vb, causal=True, interpret=interpret),
+                    lambda kb, vb: flash_block_fwd(q, kb, vb, causal=False, interpret=interpret),
+                ],
+                k_blk,
+                v_blk,
+            )
+
+        def merge(acc, step, k_blk, v_blk):
+            o, lse = acc
+            o_b, lse_b = fwd_block(step, k_blk, v_blk)
+            lse_new = jnp.logaddexp(lse, lse_b)
+            w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+            w_new = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+            return o * w_old + o_b.astype(jnp.float32) * w_new, lse_new
+
+        o0 = jnp.zeros((b, tl, h, d), jnp.float32)
+        lse0 = jnp.full((b, h, tl), _NEG_INF, jnp.float32)
+        acc = merge((o0, lse0), 0, k, v)  # own block, no communication
+
+        def body(carry, step):
+            o, lse, k_blk, v_blk = carry
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            o, lse = merge((o, lse), step, k_blk, v_blk)
+            return (o, lse, k_blk, v_blk), None
+
+        (o, lse, _, _), _ = lax.scan(body, acc + (k, v), jnp.arange(1, s))
+        return o.astype(q.dtype), lse
+
+    def bwd_kernel(q, k, v, g, o, lse):
+        delta = jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        ).transpose(0, 2, 1)  # [B, H, TL]
+
+        def bwd_block(step, k_blk, v_blk):
+            if not causal:
+                return flash_block_bwd(
+                    q, k_blk, v_blk, g, lse, delta, causal=False, interpret=interpret
+                )
+
+            def skip(_k, _v):
+                return (
+                    jnp.zeros_like(q),
+                    jnp.zeros_like(k_blk),
+                    jnp.zeros_like(v_blk),
+                )
+
+            return lax.switch(
+                block_type(step),
+                [
+                    skip,
+                    lambda kb, vb: flash_block_bwd(
+                        q, kb, vb, g, lse, delta, causal=True, interpret=interpret
+                    ),
+                    lambda kb, vb: flash_block_bwd(
+                        q, kb, vb, g, lse, delta, causal=False, interpret=interpret
+                    ),
+                ],
+                k_blk,
+                v_blk,
+            )
+
+        dq0, dk0, dv0 = bwd_block(0, k, v)
+
+        def body(carry, step):
+            dq, k_blk, v_blk, dk_blk, dv_blk = carry
+            # dk/dv ride the same rotation as their K/V blocks so each device
+            # adds its contribution to the visiting block in place.
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            dk_blk = lax.ppermute(dk_blk, axis, perm)
+            dv_blk = lax.ppermute(dv_blk, axis, perm)
+            dq_c, dk_c, dv_c = bwd_block(step, k_blk, v_blk)
+            return (dq + dq_c, k_blk, v_blk, dk_blk + dk_c, dv_blk + dv_c), None
+
+        (dq, _, _, dk, dv), _ = lax.scan(
+            body, (dq0, k, v, dk0, dv0), jnp.arange(1, s)
+        )
+        # s-1 hops so far; one more brings each dk/dv block home.
+        dk = lax.ppermute(dk, axis, perm)
+        dv = lax.ppermute(dv, axis, perm)
+        return dq, dk, dv
+
+    spec = P(None, axis, None, None)
+    lse_spec = P(None, None, axis)
+    fwd_sm = shard_map(
+        fwd_kernel,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, lse_spec),
+        check_vma=False,
+    )
+    bwd_sm = shard_map(
+        bwd_kernel,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, lse_spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return fwd_sm(q, k, v)[0]
+
+    def ring_fwd(q, k, v):
+        o, lse = fwd_sm(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def ring_bwd(res, g):
+        q, k, v, o, lse = res
+        return bwd_sm(q, k, v, g, o, lse)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(q, k, v)
 
 
 def ulysses_attention(

@@ -90,6 +90,7 @@ class Trainer:
         accum_steps: int = 1,
         num_workers: int = 8,
         log_every: int = 50,
+        last_save_period: int = 1,
         async_checkpoint: bool = True,
         profile_dir: str | None = None,
         profile_steps: int = 5,
@@ -115,6 +116,12 @@ class Trainer:
         self.accum_steps = accum_steps
         self.num_workers = num_workers
         self.log_every = log_every
+        # The reference saves `last` every epoch (``trainer/trainer.py:163``)
+        # — the right default on local disk. When the checkpoint path is slow
+        # (multi-GB states, or a chip behind a thin link where the d2h
+        # snapshot dominates the epoch), raise this to save `last` every N
+        # epochs; preemption saves still fire regardless.
+        self.last_save_period = max(1, int(last_save_period))
         self.cur_epoch = 0
         # Tracing knob (SURVEY.md §5 tracing entry; analog of the reference's
         # NCCL flight-recorder buffer, run.sh:8): when set, a jax.profiler
@@ -305,8 +312,9 @@ class Trainer:
             # last / periodic checkpoint (``:163-172``): saved epoch is
             # epoch+1 = the next epoch to train on resume (``:165-167``).
             if self.have_validate:
-                self.checkpoints.save(LAST, self.state, epoch + 1)
-                self.log(f"Saved model at epoch {epoch + 1}!")
+                if (epoch + 1) % self.last_save_period == 0 or epoch + 1 == self.max_epoch:
+                    self.checkpoints.save(LAST, self.state, epoch + 1)
+                    self.log(f"Saved model at epoch {epoch + 1}!")
             elif self.save_period and epoch % self.save_period == 0:
                 self.checkpoints.save(
                     epoch_checkpoint_name(epoch + 1), self.state, epoch + 1

@@ -18,6 +18,9 @@ cd "$(dirname "$0")"
 
 # MODEL selects the BASELINE config:
 #   (unset) / vgg16  -> config 1-2: VGG16 / CIFAR-10 (the north star)
+#   digits           -> accuracy run on real data (sklearn digits; offline
+#                       CIFAR-10 stand-in — trains, checkpoints, then evals
+#                       the saved checkpoint and prints measured top-1)
 #   resnet50         -> config 3:   ResNet-50 / ImageNet-1k
 #   vit_b16          -> config 4:   ViT-B/16  / ImageNet-1k
 #   convnext_l       -> config 5:   ConvNeXt-L / ImageNet-21k (bf16 + grad-accum)
@@ -25,6 +28,9 @@ cd "$(dirname "$0")"
 MODEL="${MODEL:-vgg16}"
 if [ "$MODEL" = "vgg16" ]; then
   exec python examples/train_cifar10.py "$@"
+fi
+if [ "$MODEL" = "digits" ]; then
+  exec python examples/train_digits.py "$@"
 fi
 if [ "$MODEL" = "lm" ]; then
   exec python examples/train_lm.py "$@"

@@ -267,3 +267,21 @@ def test_loader_collate_fn_gets_loader_mask():
     assert len(batches) == 2
     # Padded final batch: mask is loader-owned even under a custom collate.
     np.testing.assert_array_equal(batches[-1]["mask"], [1.0, 1.0, 0.0, 0.0])
+
+
+def test_loader_defaults_to_source_transform(image_root):
+    """A bare ShardedLoader(source) must apply source.transform — dropping it
+    silently feeds un-normalized images to eval (measured-accuracy bug found
+    by the digits convergence run)."""
+    src = ImageFolderDataSource(
+        image_root, ["cat", "dog", "snake"], transform=eval_transform(32, 32)
+    )
+    bare = ShardedLoader(src, 4, shuffle=False, num_workers=0,
+                         process_index=0, process_count=1)
+    explicit = ShardedLoader(src, 4, shuffle=False, num_workers=0,
+                             transform=src.transform,
+                             process_index=0, process_count=1)
+    a = next(iter(bare))["image"]
+    b = next(iter(explicit))["image"]
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.float32 and a.min() < 0, "normalization must have run"

@@ -277,3 +277,33 @@ def test_build_loss_fn_hook_override(tmp_path, mesh):
     metrics = trainer.train_epoch(0)
     assert calls, "custom loss_fn never traced"
     assert "custom_loss" in metrics and np.isfinite(metrics["custom_loss"])
+
+
+def test_last_save_period_gates_epoch_saves(tmp_path, devices):
+    """last_save_period=N saves `last` every N epochs (plus the final epoch)
+    instead of the reference's every-epoch default — the knob for slow
+    checkpoint paths. The saved resume label still points at the next epoch."""
+    import os
+
+    t = ToyTrainer(
+        max_epoch=5,
+        batch_size=16,
+        have_validate=True,
+        save_best_for=("accuracy", "geq"),
+        save_period=100,
+        last_save_period=2,
+        save_folder=str(tmp_path),
+        progress=False,
+    )
+    saves = []
+    orig = t.checkpoints.save
+
+    def spy(name, state, epoch, **kw):
+        saves.append((name, epoch))
+        return orig(name, state, epoch, **kw)
+
+    t.checkpoints.save = spy
+    t.train()
+    last_saves = [e for n, e in saves if n == LAST]
+    # epochs are 1-indexed in the save label: every 2nd + the final (5)
+    assert last_saves == [2, 4, 5], saves

@@ -91,6 +91,47 @@ def vit_train_flops_per_image(model, image_size: int) -> float:
     return 3.0 * fwd
 
 
+def resnet_train_flops_per_image(model, image_size: int) -> float:
+    """Analytic bottleneck-ResNet train FLOPs per image (2*HW*K^2*Cin*Cout per
+    conv; backward = 2x forward; BN/ReLU/pool not counted)."""
+    fwd = 0.0
+    size = image_size // 2  # 7x7/2 stem
+    fwd += 2.0 * size * size * 49 * 3 * model.width
+    size //= 2  # 3x3/2 max-pool
+    in_ch = model.width
+    for stage, num_blocks in enumerate(model.stage_sizes):
+        feats = model.width * (2**stage)
+        for block in range(num_blocks):
+            stride = 2 if stage > 0 and block == 0 else 1
+            out_size = size // stride
+            fwd += 2.0 * size * size * in_ch * feats  # 1x1 reduce (pre-stride)
+            fwd += 2.0 * out_size * out_size * 9 * feats * feats  # 3x3 (strided)
+            fwd += 2.0 * out_size * out_size * feats * 4 * feats  # 1x1 expand
+            if stride != 1 or in_ch != 4 * feats:  # projection shortcut
+                fwd += 2.0 * out_size * out_size * in_ch * 4 * feats
+            in_ch, size = 4 * feats, out_size
+    fwd += 2.0 * in_ch * model.num_classes
+    return 3.0 * fwd
+
+
+def convnext_train_flops_per_image(model, image_size: int) -> float:
+    """Analytic ConvNeXt train FLOPs per image (stem + depthwise 7x7 + the
+    dim<->4dim MLP pair per block + 2x2 downsamples; backward = 2x forward)."""
+    size = image_size // 4
+    fwd = 2.0 * size * size * 16 * 3 * model.dims[0]  # 4x4/4 stem
+    for stage, (depth, dim) in enumerate(zip(model.depths, model.dims)):
+        if stage > 0:
+            size //= 2
+            fwd += 2.0 * size * size * 4 * model.dims[stage - 1] * dim  # 2x2/2
+        per_block = (
+            2.0 * size * size * 49 * dim  # depthwise 7x7
+            + 2.0 * 2.0 * size * size * dim * 4 * dim  # MLP in + out
+        )
+        fwd += depth * per_block
+    fwd += 2.0 * model.dims[-1] * model.num_classes
+    return 3.0 * fwd
+
+
 def lm_train_flops_per_token(model, seq_len: int) -> float:
     """Analytic causal-LM train FLOPs per token: 6*P_matmul + 12*L*T*d
     attention (the standard 6N + attention convention; backward = 2x fwd
@@ -181,6 +222,26 @@ BENCH_MODELS = {
         "num_classes": 1000,
         "metric": "images/sec/chip (ViT-B/16, ImageNet-shape, bf16)",
     },
+    "resnet50": {
+        "build": lambda n: __import__(
+            "distributed_training_pytorch_tpu.models", fromlist=["ResNet50"]
+        ).ResNet50(num_classes=n, dtype=jnp.bfloat16),
+        "flops": resnet_train_flops_per_image,
+        "batch": 256,
+        "image_size": 224,
+        "num_classes": 1000,
+        "metric": "images/sec/chip (ResNet-50, ImageNet-shape, bf16)",
+    },
+    "convnext_l": {
+        "build": lambda n: __import__(
+            "distributed_training_pytorch_tpu.models", fromlist=["ConvNeXtL"]
+        ).ConvNeXtL(num_classes=n, dtype=jnp.bfloat16),
+        "flops": convnext_train_flops_per_image,
+        "batch": 128,
+        "image_size": 224,
+        "num_classes": 21841,
+        "metric": "images/sec/chip (ConvNeXt-L, ImageNet-21k-shape, bf16)",
+    },
     # size = sequence length; throughput unit is tokens (batch*T items/step).
     "lm": {
         "build": _build_lm,
@@ -196,12 +257,61 @@ BENCH_MODELS = {
         "items_per_row": lambda size: size,
     },
 }
-for _cfg in BENCH_MODELS.values():
+for _name, _cfg in BENCH_MODELS.items():
     _cfg.setdefault("unit", "images/sec/chip")
     _cfg.setdefault("make_batch", _image_batch)
     _cfg.setdefault("example_input", _image_example)
     _cfg.setdefault("make_loss", _supervised_loss)
     _cfg.setdefault("items_per_row", lambda size: 1)
+    # The scoped-VMEM bump is a VGG16-shape win (+9%); on ResNet-50 it
+    # MEASURABLY hurts (-3..5%: the deeper conv stack's weight-prefetch
+    # copies spill, v5e sweep None/32768/65536/98304). Per-model option sets.
+    _cfg.setdefault(
+        "compiler_options", tpu_compiler_options if _name in ("vgg16", "vit", "lm") else dict
+    )
+
+
+def run_e2e(batch: int, epochs: int) -> dict:
+    """End-to-end throughput: the FULL ``Trainer.train_epoch`` hot path —
+    ShardedLoader -> native C++ crop/flip (uint8) -> ``device_prefetch`` ->
+    on-device normalize -> jitted step — on materialized (synthetic-CIFAR)
+    data. This is the loop the reference times implicitly by training
+    (``trainer/trainer.py:143-156``); the step microbench above excludes the
+    input pipeline. Epoch 0 pays compiles and is discarded; the best
+    remaining epoch is reported (interference on the shared relay chip only
+    subtracts)."""
+    import shutil
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from examples.train_cifar10 import Cifar10Trainer
+
+    from distributed_training_pytorch_tpu.utils import Logger
+
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    trainer = Cifar10Trainer(
+        data_dir=os.path.join(tmp, "no-such-dir"),  # -> synthetic CIFAR shape
+        base_lr=0.1,
+        max_epoch=epochs + 1,
+        batch_size=batch,
+        have_validate=False,
+        save_folder=tmp,
+        snapshot_path=None,
+        progress=False,
+        # keep stdout to the ONE json line the driver parses
+        logger=Logger("bench-e2e", os.path.join(tmp, "log.log")),
+    )
+    n_images = len(trainer.train_dataloader) * batch
+    times = []
+    for epoch in range(epochs + 1):
+        trainer.train_dataloader.set_epoch(epoch)
+        t0 = time.perf_counter()
+        trainer.train_epoch(epoch)  # device_get of epoch metrics = sync
+        times.append(time.perf_counter() - t0)
+    shutil.rmtree(tmp, ignore_errors=True)
+    dt = min(times[1:])  # epoch 0 includes the compile
+    return {"e2e_images_per_sec": n_images / dt, "e2e_epoch_s": dt, "e2e_images": n_images}
 
 
 def main():
@@ -238,31 +348,66 @@ def main():
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.
-    # tpu_compiler_options: scoped-VMEM bump, measured +9% (utils/tpu.py).
-    compiled = engine.compile_train_step(
-        state, gbatch, compiler_options=tpu_compiler_options()
-    )
-    cost = compiled.cost_analysis()
-    xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    # Per-model compiler options (scoped-VMEM bump where it measures faster).
+    #
+    # BENCH_CHAIN (default on): the window's `steps` train steps are chained
+    # on-device (engine.compile_chained_train_steps) so one dispatch runs the
+    # whole window back-to-back — the production dispatch regime (local PJRT
+    # ~0.1 ms/call). Per-call dispatch through this environment's chip relay
+    # costs ~6-8 ms, which is harness artifact, not step time. BENCH_CHAIN=0
+    # restores per-step dispatch for comparison.
+    chain = os.environ.get("BENCH_CHAIN", "1") != "0"
+    opts = cfg["compiler_options"]() or None
     step_flops = flops_fn(model, image_size) * batch * cfg["items_per_row"](image_size)
+    if chain:
+        # One backend compile total: XLA's FLOP estimate comes from the
+        # chained executable itself. cost_analysis counts the scan BODY once
+        # (verified on v5e: chained flops == single-step flops exactly), so
+        # it already IS the per-step figure.
+        compiled = engine.compile_chained_train_steps(
+            state, gbatch, steps, compiler_options=opts
+        )
+        cost = compiled.cost_analysis()
+        xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        run_window = lambda st: compiled(st, gbatch)
+    else:
+        probe = engine.compile_train_step(state, gbatch, compiler_options=opts)
+        cost = probe.cost_analysis()
+        xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
-    # Warmup, then best of `windows` timed windows — the chip is shared behind
-    # a relay here and external interference only ever subtracts, so the
-    # fastest window is the estimate of sustained capability (standard
-    # microbenchmark practice). Sync via a scalar device_get —
-    # block_until_ready alone can be a no-op on relay-backed platforms.
-    state, m = compiled(state, gbatch)
+        def run_window(st):
+            for _ in range(steps):
+                st, metrics = probe(st, gbatch)
+            return st, metrics
+
+    # Warmup, then best of `windows` timed windows (the shared relay chip's
+    # interference only ever subtracts; BENCH_REDUCE=median reports the
+    # median instead — measured ~5% below best-of, the spread being relay
+    # noise, not step variance: chained windows pin the device loop). Sync
+    # via a scalar device_get — block_until_ready alone can be a no-op on
+    # relay-backed platforms.
+    state, m = run_window(state)
     _ = float(m["loss"])
     per_step = []
     for w in range(windows):
         if w:
             time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
         t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = compiled(state, gbatch)
+        state, metrics = run_window(state)
         _ = float(metrics["loss"])
         per_step.append((time.perf_counter() - t0) / steps)
-    dt = min(per_step)
+    reduce = os.environ.get("BENCH_REDUCE", "min")
+    dt = float(np.median(per_step)) if reduce == "median" else min(per_step)
+
+    # BENCH_E2E=1 (vgg16 mode): also run the input-pipeline-fed epoch loop
+    # and report it next to the device-step number (VERDICT r2 item 2).
+    e2e = {}
+    if os.environ.get("BENCH_E2E") == "1" and model_name == "vgg16":
+        e2e = run_e2e(batch, epochs=int(os.environ.get("BENCH_E2E_EPOCHS", "3")))
+        e2e = {k: round(v, 2) if isinstance(v, float) else v for k, v in e2e.items()}
+        e2e["e2e_vs_step"] = round(
+            e2e["e2e_images_per_sec"] / (batch * cfg["items_per_row"](image_size) / dt), 4
+        )
 
     n_chips = len(jax.devices())
     items = batch * cfg["items_per_row"](image_size)
@@ -282,6 +427,7 @@ def main():
                 "mfu_xla": round(mfu_xla, 4),
                 "batch": batch,
                 "step_ms": round(dt * 1e3, 2),
+                **e2e,
             }
         )
     )

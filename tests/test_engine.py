@@ -145,3 +145,21 @@ def test_state_sharding_rejects_foreign_state(devices):
     batch = engine.shard_batch(synthetic_batch())
     state, metrics = engine.train_step(state, batch)
     assert np.isfinite(float(metrics["ce_loss"]))
+
+
+def test_chained_steps_match_sequential(devices):
+    """compile_chained_train_steps(K) == K sequential train_steps (same RNG
+    advance via state.step, same params) — the bench's one-dispatch window."""
+    batch_np = synthetic_batch(16)
+    eng_a, state_a = make_engine()
+    eng_b, state_b = make_engine()
+    ba = eng_a.shard_batch(batch_np)
+    bb = eng_b.shard_batch(batch_np)
+    for _ in range(4):
+        state_a, m_a = eng_a.train_step(state_a, ba)
+    chained = eng_b.compile_chained_train_steps(state_b, bb, 4)
+    state_b, m_b = chained(state_b, bb)
+    assert int(state_b.step) == int(state_a.step) == 4
+    np.testing.assert_allclose(float(m_b["ce_loss"]), float(m_a["ce_loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -279,6 +279,49 @@ for _name, _cfg in BENCH_MODELS.items():
     )
 
 
+def build_bench_setup(model_name: str | None = None):
+    """One source of truth for the executable a ``BENCH_MODEL`` names: build
+    the registry model + engine + AOT state + sharded batch + per-model
+    compiler options from the same env knobs ``main()`` honors. Used by
+    ``main()`` and ``scripts/profile_step.py`` so the profiled program IS the
+    timed one."""
+    model_name = model_name or os.environ.get("BENCH_MODEL", "vgg16")
+    if model_name not in BENCH_MODELS:
+        raise SystemExit(
+            f"unknown BENCH_MODEL {model_name!r} (choose from {sorted(BENCH_MODELS)})"
+        )
+    cfg = BENCH_MODELS[model_name]
+    batch = int(os.environ.get("BENCH_BATCH", str(cfg["batch"])))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", str(cfg["image_size"])))
+    mesh = mesh_lib.create_mesh()
+    model = cfg["build"](cfg["num_classes"], image_size)
+    engine = TrainEngine(
+        cfg["make_loss"](model),
+        optax.sgd(0.01, momentum=0.9),
+        mesh,
+        accum_steps=int(os.environ.get("BENCH_ACCUM", str(cfg.get("accum_steps", 1)))),
+    )
+    state = engine.init_state(
+        jax.random.key(0),
+        lambda rng: model.init(rng, cfg["example_input"](image_size)),
+    )
+    rng = np.random.RandomState(0)
+    gbatch = engine.shard_batch(
+        cfg["make_batch"](rng, batch, image_size, cfg["num_classes"], model)
+    )
+    return {
+        "model_name": model_name,
+        "cfg": cfg,
+        "batch": batch,
+        "image_size": image_size,
+        "model": model,
+        "engine": engine,
+        "state": state,
+        "gbatch": gbatch,
+        "compiler_options": cfg["compiler_options"]() or None,
+    }
+
+
 def run_e2e(batch: int, epochs: int) -> dict:
     """End-to-end throughput: the FULL ``Trainer.train_epoch`` hot path —
     ShardedLoader -> native C++ crop/flip (uint8) -> ``device_prefetch`` ->
@@ -324,36 +367,17 @@ def run_e2e(batch: int, epochs: int) -> dict:
 
 def main():
     enable_fast_rng()
-    model_name = os.environ.get("BENCH_MODEL", "vgg16")
-    if model_name not in BENCH_MODELS:
-        raise SystemExit(
-            f"unknown BENCH_MODEL {model_name!r} (choose from {sorted(BENCH_MODELS)})"
-        )
-    cfg = BENCH_MODELS[model_name]
-    batch = int(os.environ.get("BENCH_BATCH", str(cfg["batch"])))
+    setup = build_bench_setup()
+    model_name, cfg = setup["model_name"], setup["cfg"]
+    batch, image_size = setup["batch"], setup["image_size"]
+    model, engine, state, gbatch = (
+        setup["model"], setup["engine"], setup["state"], setup["gbatch"]
+    )
+    flops_fn = cfg["flops"]
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     # Several short windows spread over ~1 min: the shared chip's slow phases
     # last tens of seconds, and best-of-windows should sample past them.
     windows = int(os.environ.get("BENCH_WINDOWS", "6"))
-    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", str(cfg["image_size"])))
-    num_classes = cfg["num_classes"]
-
-    mesh = mesh_lib.create_mesh()
-    model, flops_fn = cfg["build"](num_classes, image_size), cfg["flops"]
-
-    engine = TrainEngine(
-        cfg["make_loss"](model),
-        optax.sgd(0.01, momentum=0.9),
-        mesh,
-        accum_steps=int(os.environ.get("BENCH_ACCUM", str(cfg.get("accum_steps", 1)))),
-    )
-    state = engine.init_state(
-        jax.random.key(0),
-        lambda rng: model.init(rng, cfg["example_input"](image_size)),
-    )
-
-    rng = np.random.RandomState(0)
-    gbatch = engine.shard_batch(cfg["make_batch"](rng, batch, image_size, num_classes, model))
 
     # Compile the engine's own step once (AOT), read XLA's FLOP estimate from
     # it, and run that same executable in the timed loop — one compile total.
@@ -366,7 +390,7 @@ def main():
     # costs ~6-8 ms, which is harness artifact, not step time. BENCH_CHAIN=0
     # restores per-step dispatch for comparison.
     chain = os.environ.get("BENCH_CHAIN", "1") != "0"
-    opts = cfg["compiler_options"]() or None
+    opts = setup["compiler_options"]
     step_flops = flops_fn(model, image_size) * batch * cfg["items_per_row"](image_size)
     if chain:
         # One backend compile total: XLA's FLOP estimate comes from the
@@ -378,6 +402,17 @@ def main():
         )
         cost = compiled.cost_analysis()
         xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        # Guard (ADVICE r3): the per-step figure above relies on XLA counting
+        # the scan body ONCE (verified on this version: chained == single-step
+        # flops exactly). If a future XLA multiplies by trip count, the
+        # chained figure lands ~steps x the analytic count — detect that via
+        # the analytic anchor (XLA's own count never exceeds ~1.2x analytic;
+        # an excess beyond max(steps/2, 2) can only be trip-count
+        # multiplication — the floor of 2 keeps a legitimate ~1.2x ratio from
+        # tripping the guard at small BENCH_STEPS) and divide back down
+        # rather than silently inflating mfu_xla.
+        if steps > 1 and step_flops > 0 and xla_step_flops / step_flops > max(steps / 2, 2):
+            xla_step_flops /= steps
         run_window = lambda st: compiled(st, gbatch)
     else:
         probe = engine.compile_train_step(state, gbatch, compiler_options=opts)

@@ -49,6 +49,7 @@ class ShardedLoader:
         transform: Optional[Callable] = None,
         collate_fn: Optional[Callable] = None,
         num_workers: int = 8,
+        prefetch_batches: int = 2,
         drop_last: bool = True,
         pad_final: bool = False,
         process_index: int | None = None,
@@ -78,6 +79,13 @@ class ShardedLoader:
         self.seed = seed
         self.transform = transform
         self.num_workers = int(num_workers)
+        # Host-side look-ahead window: how many *batches* may be in flight
+        # (decoding/augmenting) beyond the one being consumed. Distinct from
+        # the device-side ``device_prefetch(depth=2)`` ring downstream of the
+        # loader (utils/tpu.py): this knob bounds host RAM (window x batch
+        # bytes) and decode overlap; that one bounds on-device staging. The
+        # defaults compose: 2 host batches decoding while 2 sit on device.
+        self.prefetch_batches = max(1, int(prefetch_batches))
         self.drop_last = drop_last
         self.pad_final = pad_final
         self._epoch = 0
@@ -208,7 +216,7 @@ class ShardedLoader:
         # and GIL-free); the Python path fans out per record.
         with cf.ThreadPoolExecutor(self.num_workers) as pool:
             window: queue.Queue = queue.Queue()
-            ahead = 2
+            ahead = self.prefetch_batches
 
             def submit(b: int):
                 rows, mask = batch_indices(b)

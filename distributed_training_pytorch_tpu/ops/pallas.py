@@ -43,9 +43,25 @@ NEG_INF = -1e30  # large-negative logit for masked positions (f32-safe)
 # 2.9x faster than 128-blocks at T=1024 and 4.3x at T=8192 (128: 105/294 ms;
 # 1024: 36.8/67.8 ms) — bigger q-tiles amortize the K/V streaming loop and
 # fill the MXU; (bq,bk) beyond (1024,1024) exceeds scoped VMEM at long T.
-# Blocks auto-clamp to T, so short sequences are unaffected.
+# Blocks auto-clamp to T (rounded up to the 128-lane tile, _block_size),
+# so short sequences are unaffected.
 _DEFAULT_BLOCK_Q = 1024
 _DEFAULT_BLOCK_K = 1024
+
+
+def _block_size(block: int, t: int) -> int:
+    """Clamp a block size to the sequence, rounded up to the MXU tile.
+
+    A raw ``min(block, t)`` leaves ragged blocks at short T (ViT-B's 197),
+    and a 197-wide tile maps terribly onto the 128-lane MXU / (8,128) VMEM
+    tiling — re-measured on v5e at T=197: aligned 256-blocks run the
+    fwd+bwd kernels 2.3x faster than 197-blocks. Padded rows/cols are
+    masked by ``seq_len`` inside the kernels (K side) or sliced off by the
+    callers (q side), so alignment costs only the pad FLOPs.
+    """
+    if t >= block:
+        return block
+    return min(block, ((max(t, 1) + 127) // 128) * 128)
 
 
 def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
@@ -328,8 +344,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     qt, kt, vt, o, lse, q_shape = res
     b, t, h, d = q_shape
-    bq = min(block_q, max(t, 1))
-    bk = min(block_k, max(t, 1))
+    bq = _block_size(block_q, t)
+    bk = _block_size(block_k, t)
     tq_pad = qt.shape[2]
 
     do = _pad_to(_to_bhtd(g), tq_pad, 2)
@@ -368,8 +384,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def _ring_pad(q, k, v, block_q, block_k):
     tq, tk = q.shape[1], k.shape[1]
-    bq = min(block_q, max(tq, 1))
-    bk = min(block_k, max(tk, 1))
+    bq = _block_size(block_q, tq)
+    bk = _block_size(block_k, tk)
     qt = _pad_to(_to_bhtd(q), pl.cdiv(tq, bq) * bq, 2)
     kt = _pad_to(_to_bhtd(k), pl.cdiv(tk, bk) * bk, 2)
     vt = _pad_to(_to_bhtd(v), pl.cdiv(tk, bk) * bk, 2)

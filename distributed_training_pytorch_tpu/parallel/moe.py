@@ -23,6 +23,10 @@ Two dispatch implementations with identical routing semantics (parity-tested):
   ``[E, C, d]`` buffers and gather back; memory and compute O(S·k + E·C·d)
   per group, no quadratic one-hots. Best at large group sizes. The measured
   single-chip crossover is recorded in BASELINE.md (``bench.py`` moe mode).
+* ``dispatch_impl="auto"`` (default) — picks per call site from the static
+  group size: ``sort`` at >= :data:`SORT_DISPATCH_MIN_GROUP` tokens/group
+  (the measured ~4k crossover), ``einsum`` below. Group size is shape-derived,
+  so the choice is made at trace time — no runtime branch under jit.
 
 Inference: ``__call__(x, decode=True)`` routes capacity-free — every token
 computes its top-k experts by direct weight gather (no buffers, no drops), the
@@ -45,19 +49,42 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_training_pytorch_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
 
-__all__ = ["EXPERT_AXIS", "MoEMlp", "load_balance_loss", "router_z_loss"]
+__all__ = [
+    "EXPERT_AXIS",
+    "MoEMlp",
+    "SORT_DISPATCH_MIN_GROUP",
+    "load_balance_loss",
+    "router_z_loss",
+]
+
+# Measured einsum/sort crossover (single v5e chip, fwd+bwd, E=8 k=2 d=512
+# h=1024 bf16 — BASELINE.md "MoE dispatch crossover"): einsum wins at 1k
+# tokens/group (20.4 vs 23.1 ms), ties at 4k, loses 2x at 16k (40.4 vs
+# 20.3 ms). "auto" flips to sort at this group size.
+SORT_DISPATCH_MIN_GROUP = 4096
 
 
-def _constrain(x: jax.Array, axes: tuple) -> jax.Array:
+def _constrain(x: jax.Array, axes: tuple, *, activation: bool = False) -> jax.Array:
     """Constrain dims to mesh axes, skipping axes the ambient mesh lacks.
 
     No ambient mesh (plain apply outside jit, tests) -> no-op. With a mesh,
     genuine spec errors (e.g. expert count not divisible by the axis) DO
     propagate — silently dropping the constraint would run fully replicated
-    while the user believes expert parallelism is active."""
+    while the user believes expert parallelism is active.
+
+    ``activation=True`` marks dispatch/combine activation constraints, which
+    are belt-and-braces: the expert-sharded WEIGHT constraints alone already
+    make GSPMD shard the expert einsums. Inside a partial-manual region (a
+    ``shard_map`` manual over e.g. ``pipe``, as ``pipeline_apply`` builds),
+    activation constraints trip an XLA SPMD-partitioner CHECK
+    (spmd_partitioner_util.cc "partition_group_list ... num_devices_per_group",
+    bisected on jax 0.9/CPU) — so they are skipped there, and expert layout
+    flows from the weights."""
     mesh = jax.sharding.get_abstract_mesh()
     mesh_axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
     if not mesh_axes:
+        return x
+    if activation and getattr(mesh, "manual_axes", ()):
         return x
     spec = P(*[a if (a is not None and a in mesh_axes) else None for a in axes])
     return jax.lax.with_sharding_constraint(x, spec)
@@ -102,7 +129,7 @@ class MoEMlp(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     num_groups: int = 1
-    dispatch_impl: str = "einsum"
+    dispatch_impl: str = "auto"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -113,8 +140,10 @@ class MoEMlp(nn.Module):
         s = tokens.shape[0]
         e = self.num_experts
         g = self.num_groups
-        if self.dispatch_impl not in ("einsum", "sort"):
-            raise ValueError(f"dispatch_impl must be einsum|sort, got {self.dispatch_impl!r}")
+        if self.dispatch_impl not in ("auto", "einsum", "sort"):
+            raise ValueError(
+                f"dispatch_impl must be auto|einsum|sort, got {self.dispatch_impl!r}"
+            )
 
         # --- router (float32 for stable softmax) ---------------------------
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
@@ -160,6 +189,10 @@ class MoEMlp(nn.Module):
             raise ValueError(f"{s} tokens not divisible by num_groups={g}")
         sg = s // g
         capacity = max(1, int(np.ceil(sg * self.top_k / e * self.capacity_factor)))
+        # Resolve "auto" from the static group size (known at trace time).
+        impl = self.dispatch_impl
+        if impl == "auto":
+            impl = "sort" if sg >= SORT_DISPATCH_MIN_GROUP else "einsum"
 
         # --- per-group top-k routing with order-based capacity --------------
         # Choices claim capacity in priority order (choice 0 of every token in
@@ -240,9 +273,9 @@ class MoEMlp(nn.Module):
         # The reshard from token-sharded [G over data] to expert-sharded IS
         # the all-to-all (inserted by the SPMD partitioner at the constraint).
         grouped_tokens = tokens.reshape(g, sg, d)
-        grouped_tokens = _constrain(grouped_tokens, (DATA_AXIS,))
+        grouped_tokens = _constrain(grouped_tokens, (DATA_AXIS,), activation=True)
 
-        if self.dispatch_impl == "sort":
+        if impl == "sort":
             expert_in, rows, w_flat, first_choice = jax.vmap(route_sort)(
                 grouped_gates, grouped_tokens
             )
@@ -263,12 +296,12 @@ class MoEMlp(nn.Module):
         self.sow("intermediates", "router_z_loss", router_z_loss(logits))
 
         # --- expert computation (expert-sharded) ---------------------------
-        expert_in = _constrain(expert_in, (DATA_AXIS, EXPERT_AXIS))
+        expert_in = _constrain(expert_in, (DATA_AXIS, EXPERT_AXIS), activation=True)
         h = jax.nn.gelu(jnp.einsum("gecd,edh->gech", expert_in, w_in))
         expert_out = jnp.einsum("gech,ehd->gecd", h, w_out)
-        expert_out = _constrain(expert_out, (DATA_AXIS, EXPERT_AXIS))
+        expert_out = _constrain(expert_out, (DATA_AXIS, EXPERT_AXIS), activation=True)
 
-        if self.dispatch_impl == "sort":
+        if impl == "sort":
             out = jax.vmap(combine_sort)(expert_out, rows, w_flat)
         else:
             out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), expert_out)

@@ -53,10 +53,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 ships shard_map at top level; the experimental path warns
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# jax >= 0.9: top-level shard_map with `axis_names` (partial-manual mode,
+# which pipeline_apply requires — the old experimental shard_map's auto=
+# parameter has different semantics, so no fallback import is kept).
+from jax import shard_map
 
 from distributed_training_pytorch_tpu.parallel.mesh import PIPE_AXIS
 
@@ -319,6 +319,13 @@ def pipeline_apply(
         # Plain path: the closing psum establishes replication. Sharded-head
         # path: outputs stay sharded over `axis` on dim 1, reassembled below.
         out_specs=P(None, axis) if sharded_head else P(),
+        # Manual over the pipe axis ONLY: every other mesh axis stays
+        # automatic, so stage bodies compose with the rest of the matrix —
+        # activations sharded over `data`, MoE weights over `expert`, TP over
+        # `model` — with GSPMD inserting those collectives inside each tick
+        # while the ring ppermute stays hand-scheduled. On a pipe-only mesh
+        # this is identical to full manual.
+        axis_names=frozenset({axis}),
     )
     out = fn(chunked, micro_in, first_params, last_params)
     if sharded_head:

@@ -257,7 +257,14 @@ def _ring_attention_flash(q, k, v, mesh, *, axis, causal):
                 v_blk,
             )
 
-        dq0, dk0, dv0 = bwd_block(0, k, v)
+        # Accumulate dq/dk/dv in float32 across ring steps (the kernels
+        # already accumulate f32 *within* a block; without this the
+        # cross-step += happens in the input dtype and rounding error grows
+        # with ring size — matching the f32 statistics the forward keeps).
+        # dk/dv therefore ride the ring as f32: 2x the ICI bytes of the
+        # bf16 activations, bought for s-step-independent gradient error.
+        f32 = lambda t: t.astype(jnp.float32)
+        dq0, dk0, dv0 = map(f32, bwd_block(0, k, v))
 
         def body(carry, step):
             dq, k_blk, v_blk, dk_blk, dv_blk = carry
@@ -268,7 +275,7 @@ def _ring_attention_flash(q, k, v, mesh, *, axis, causal):
             dk_blk = lax.ppermute(dk_blk, axis, perm)
             dv_blk = lax.ppermute(dv_blk, axis, perm)
             dq_c, dk_c, dv_c = bwd_block(step, k_blk, v_blk)
-            return (dq + dq_c, k_blk, v_blk, dk_blk + dk_c, dv_blk + dv_c), None
+            return (dq + f32(dq_c), k_blk, v_blk, dk_blk + f32(dk_c), dv_blk + f32(dv_c)), None
 
         (dq, _, _, dk, dv), _ = lax.scan(
             body, (dq0, k, v, dk0, dv0), jnp.arange(1, s)
@@ -276,7 +283,7 @@ def _ring_attention_flash(q, k, v, mesh, *, axis, causal):
         # s-1 hops so far; one more brings each dk/dv block home.
         dk = lax.ppermute(dk, axis, perm)
         dv = lax.ppermute(dv, axis, perm)
-        return dq, dk, dv
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     spec = P(None, axis, None, None)
     lse_spec = P(None, None, axis)

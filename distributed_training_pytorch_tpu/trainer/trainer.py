@@ -89,6 +89,7 @@ class Trainer:
         seed: int = 0,
         accum_steps: int = 1,
         num_workers: int = 8,
+        prefetch_batches: int = 2,
         log_every: int = 50,
         last_save_period: int = 1,
         async_checkpoint: bool = True,
@@ -115,6 +116,10 @@ class Trainer:
         self.seed = seed
         self.accum_steps = accum_steps
         self.num_workers = num_workers
+        # Host-side batch look-ahead (ShardedLoader window). Composes with
+        # the device-side device_prefetch(depth=2) ring in train_epoch: this
+        # bounds host decode-ahead, that bounds on-device staging.
+        self.prefetch_batches = prefetch_batches
         self.log_every = log_every
         # The reference saves `last` every epoch (``trainer/trainer.py:163``)
         # — the right default on local disk. When the checkpoint path is slow
@@ -229,6 +234,7 @@ class Trainer:
             # dataset.collate_fn (ref trainer/trainer.py:59-71) is picked up
             # by the ShardedLoader ctor's own fallback.
             num_workers=self.num_workers,
+            prefetch_batches=self.prefetch_batches,
             drop_last=train,
             pad_final=not train,
         )

@@ -109,12 +109,22 @@ def _fields(buf: bytes):
         yield field, wire, val
 
 
-def top_ops(log_dir: str, *, limit: int = 20) -> list[tuple[str, float, int]]:
+def top_ops(
+    log_dir: str, *, limit: int = 20, line: str | None = None
+) -> list[tuple[str, float, int]]:
     """Summarize the newest trace in ``log_dir``: device ops by total time.
 
     Returns ``[(op_name, total_time_us, occurrences), ...]`` over the device
     (TPU/GPU) planes, sorted descending — a headless op profile; no
     TensorBoard server needed.
+
+    ``line`` filters to one named trace line. The TPU device plane carries
+    several: ``"XLA Ops"`` is the synchronous critical path (its events sum
+    to wall step time), ``"Async XLA Ops"`` holds overlapped DMA/prefetch
+    copies whose durations span their async windows — summing across both
+    double-counts overlap, so per-op accounting should pass
+    ``line="XLA Ops"``. Default (None) keeps every line, preserving the
+    "everything the device did" view.
     """
     path = latest_trace_file(log_dir)
     if path is None:
@@ -144,9 +154,15 @@ def top_ops(log_dir: str, *, limit: int = 20) -> list[tuple[str, float, int]]:
         if "TPU" not in plane_name and "GPU" not in plane_name:
             continue
         for line_buf in lines:
+            line_name, events = "", []
             for lf, _, lv in _fields(line_buf):
-                if lf != 4:  # XLine.events
-                    continue
+                if lf == 2:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 4:  # XLine.events
+                    events.append(lv)
+            if line is not None and line_name != line:
+                continue
+            for lv in events:
                 mid = dur_ps = 0
                 for ef, _, ev in _fields(lv):
                     if ef == 1:

@@ -1,0 +1,73 @@
+"""Materialize a real byte-level LM corpus from in-env text.
+
+The environment is offline (BASELINE.md), so the LM train-to-accuracy proof
+(r3 VERDICT item 2) uses genuine text that ships with the image: the Python
+standard library's source files plus installed-package documentation — real,
+human-written prose and code, ~tens of MB. Deterministic: files are collected
+in sorted order, so every run (and every host) builds the identical corpus.
+
+Usage:  python examples/make_lm_corpus.py [out_path] [max_mb]
+        (defaults: ./runs/lm_corpus.txt, 24 MB)
+The output feeds ``LM_CORPUS=<out_path> MODEL=lm ./run.sh``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Real text roots, preference order: stdlib source (prose-rich docstrings),
+# then package docs/READMEs. Sorted traversal => deterministic corpus.
+ROOTS = [
+    ("/usr/lib/python3.11", (".py",)),
+    ("/opt/venv/lib/python3.12/site-packages/numpy", (".py", ".rst", ".txt")),
+    ("/opt/venv/lib/python3.12/site-packages/jax", (".py",)),
+]
+
+
+def collect(max_bytes: int) -> bytes:
+    chunks: list[bytes] = []
+    total = 0
+    for root, exts in ROOTS:
+        if total >= max_bytes or not os.path.isdir(root):
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames.sort()
+            if "__pycache__" in dirpath or "/test" in dirpath:
+                continue
+            for name in sorted(filenames):
+                if not name.endswith(tuple(exts)):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                # Text files only: skip anything that does not decode.
+                try:
+                    data.decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+                chunks.append(data)
+                chunks.append(b"\n\n")
+                total += len(data) + 2
+                if total >= max_bytes:
+                    break
+            if total >= max_bytes:
+                break
+    return b"".join(chunks)[:max_bytes]
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "./runs/lm_corpus.txt"
+    max_mb = float(sys.argv[2]) if len(sys.argv) > 2 else 24.0
+    data = collect(int(max_mb * 1e6))
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {len(data):,} bytes of real in-env text to {out}")
+
+
+if __name__ == "__main__":
+    main()

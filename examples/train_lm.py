@@ -6,10 +6,13 @@ Trainer the vision configs use. Input is a byte-level corpus file split into
 fixed windows (``LM_CORPUS``); without one, a synthetic structured byte stream
 keeps the entry smoke-runnable anywhere.
 
-Launch: ``MODEL=lm ./run.sh``. Env knobs: ``LM_CORPUS`` (text/bytes file),
-``SEQ_LEN`` (default 256), ``EPOCHS``, ``BATCH``, ``BASE_LR``, ``MOE_EVERY``
-(0 = dense), ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``, ``LM_SIZE``
-(``tiny`` | ``small`` = GPT-2-small shape).
+Launch: ``MODEL=lm ./run.sh``. Env knobs: ``LM_CORPUS`` (text/bytes file —
+build a real one offline with ``examples/make_lm_corpus.py``), ``SEQ_LEN``
+(default 256), ``EPOCHS``, ``BATCH``, ``BASE_LR``, ``MOE_EVERY`` (0 = dense),
+``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``, ``LM_SIZE`` (``tiny`` | ``small``
+= GPT-2-small shape), ``SAVE_PERIOD`` / ``LAST_SAVE_PERIOD`` (epochs between
+periodic / `last` saves — raise both when the checkpoint path is slow, e.g.
+a chip behind a relay where a GPT-small save costs minutes).
 """
 
 from __future__ import annotations
@@ -149,7 +152,8 @@ if __name__ == "__main__":
         batch_size=int(os.environ.get("BATCH", "256")),
         have_validate=True,
         save_best_for=("nll", "leq"),
-        save_period=1,
+        save_period=int(os.environ.get("SAVE_PERIOD", "1")),
+        last_save_period=int(os.environ.get("LAST_SAVE_PERIOD", "1")),
         save_folder=save_dir,
         snapshot_path=os.environ.get("SNAPSHOT") or None,
         logger=Logger("lm", os.path.join(save_dir, "logfile.log")),

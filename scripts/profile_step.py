@@ -1,0 +1,98 @@
+"""Profile a compiled train step: headless per-op device-time table.
+
+Builds the exact executable ``bench.py`` times (same model registry, batch,
+compiler options), runs a traced window, and prints the top device ops by
+self-time plus a category rollup (conv fwd / dgrad / wgrad, fusions, copies,
+BN-ish elementwise, all-else). This is the profile-first tool the zoo-config
+perf work runs before touching any model (VERDICT r3 items 1/3/6).
+
+Usage:  BENCH_MODEL=resnet50 python scripts/profile_step.py
+Env:    PROFILE_STEPS (default 3 traced steps), PROFILE_LIMIT (table rows),
+        plus every BENCH_* knob bench.py honors.
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+from distributed_training_pytorch_tpu.utils.profiling import top_ops, trace
+from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
+
+
+def categorize(name: str) -> str:
+    """Bucket an HLO op name from the critical-path trace line."""
+    head = name.split(" = ")[0]
+    if "convolution" in name:
+        return "convolution"
+    if "select_and_scatter" in name or "select-and-scatter" in name:
+        return "pool-backward"
+    if "reduce_window" in name or "reduce-window" in name:
+        return "pool-forward"
+    if "all-reduce" in name or "all-gather" in name or "reduce-scatter" in name:
+        return "collective"
+    if "copy" in head or "transpose" in head or "bitcast" in head:
+        return "copy/transpose"
+    if "reduce" in head:  # BN batch statistics, loss reductions
+        return "reduce(stats)"
+    if "fusion" in head:
+        return "fusion(elementwise)"
+    if "dot" in head or "custom-call" in head:
+        return "matmul"
+    return "other"
+
+
+def main():
+    enable_fast_rng()
+    steps = int(os.environ.get("PROFILE_STEPS", "3"))
+    limit = int(os.environ.get("PROFILE_LIMIT", "40"))
+
+    # Exactly the executable bench.py times (shared builder, same env knobs).
+    setup = bench.build_bench_setup(os.environ.get("BENCH_MODEL", "resnet50"))
+    model_name, batch, image_size = (
+        setup["model_name"], setup["batch"], setup["image_size"]
+    )
+    engine, state, gbatch = setup["engine"], setup["state"], setup["gbatch"]
+    compiled = engine.compile_train_step(
+        state, gbatch, compiler_options=setup["compiler_options"]
+    )
+
+    # Warm (first call on the relay pays dispatch setup), then trace.
+    state, m = compiled(state, gbatch)
+    _ = float(m["loss"])
+    log_dir = os.environ.get("PROFILE_DIR") or tempfile.mkdtemp(prefix=f"prof_{model_name}_")
+    with trace(log_dir):
+        for _ in range(steps):
+            state, m = compiled(state, gbatch)
+        _ = float(m["loss"])
+
+    # "XLA Ops" is the synchronous critical path: its events sum to wall step
+    # time. (The "Async XLA Ops" line holds overlapped DMA windows — summing
+    # it in would double-count; see utils/profiling.top_ops docstring.)
+    op_rows = top_ops(log_dir, limit=2000, line="XLA Ops")
+    op_total = sum(t for _, t, _ in op_rows)
+    async_rows = top_ops(log_dir, limit=2000, line="Async XLA Ops")
+    async_total = sum(t for _, t, _ in async_rows)
+
+    print(f"# profile: {model_name} batch={batch} size={image_size} "
+          f"steps={steps} (trace {log_dir})")
+    print(f"# critical path (XLA Ops line): {op_total/1e3:.2f} ms over {steps} steps "
+          f"= {op_total/1e3/steps:.2f} ms/step  |  async DMA windows "
+          f"(overlapped): {async_total/1e3:.2f} ms")
+    cats: dict[str, float] = {}
+    for name, t, _ in op_rows:
+        cats[categorize(name)] = cats.get(categorize(name), 0.0) + t
+    print("\n## category rollup (self-time)")
+    for cat, t in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {cat:12s} {t/1e3:9.2f} ms  {100*t/op_total:5.1f}%")
+    print(f"\n## top {limit} ops")
+    for name, t, n in op_rows[:limit]:
+        short = re.sub(r"\s+", " ", name)[:160]
+        print(f"  {t/1e3:8.2f} ms  x{n:<4d} {100*t/op_total:5.1f}%  {short}")
+
+
+if __name__ == "__main__":
+    main()

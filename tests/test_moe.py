@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.parallel import moe as moe_lib
 from distributed_training_pytorch_tpu.parallel.moe import EXPERT_AXIS, MoEMlp
 
 
@@ -216,6 +217,37 @@ def test_moe_decode_capacity_free_matches_dense():
     out_train = np.asarray(model.apply(variables, x)).reshape(-1, 8)
     assert (np.abs(out_train).sum(-1) == 0).any()
     assert (np.abs(np.asarray(out).reshape(-1, 8)).sum(-1) > 0).all()
+
+
+@pytest.mark.parametrize(
+    "tokens,expected_impl",
+    [(16, "einsum"), (moe_lib.SORT_DISPATCH_MIN_GROUP, "sort")],
+)
+def test_moe_auto_dispatch_selects_by_group_size(tokens, expected_impl, monkeypatch):
+    """dispatch_impl='auto' (the default) resolves from the static group size
+    at the measured ~4k crossover — and produces the same numbers as the impl
+    it selects."""
+    seen = []
+    orig_vmap = jax.vmap
+
+    def spy_vmap(fn, *a, **kw):
+        if getattr(fn, "__name__", "") in ("route", "route_sort"):
+            seen.append(fn.__name__)
+        return orig_vmap(fn, *a, **kw)
+
+    monkeypatch.setattr(moe_lib.jax, "vmap", spy_vmap)
+    kw = dict(num_experts=4, hidden_dim=8, top_k=2, capacity_factor=2.0)
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(1, tokens, 8), jnp.float32)
+    auto = MoEMlp(dispatch_impl="auto", **kw)
+    variables = auto.init(jax.random.key(2), x)
+    seen.clear()
+    out_auto = auto.apply(variables, x)
+    assert seen == [{"einsum": "route", "sort": "route_sort"}[expected_impl]]
+    out_explicit = MoEMlp(dispatch_impl=expected_impl, **kw).apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(out_auto), np.asarray(out_explicit), atol=2e-5
+    )
 
 
 def test_moe_rejects_unknown_dispatch_impl():

@@ -33,11 +33,15 @@ class MlpBlock(nn.Module):
         return x
 
 
-def dot_product_attention(q, k, v, *, dtype=jnp.float32):
+def dot_product_attention(q, k, v, *, dtype=jnp.float32, valid_len=None):
     """Plain softmax attention: [B, T, H, D] inputs, MXU-batched matmuls,
-    float32 softmax accumulation."""
+    float32 softmax accumulation. ``valid_len`` masks key positions >= it
+    (the tail of a tile-padded sequence, see ``ViT.pad_seq_to``)."""
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if valid_len is not None and valid_len < k.shape[1]:
+        mask = jnp.arange(k.shape[1]) < valid_len  # [Tk]
+        logits = jnp.where(mask[None, None, None, :], logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
@@ -68,6 +72,9 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
     # Optional fused kernel: (q, k, v) -> out, same [B, T, H, D] layout.
     attention_fn: Optional[Callable] = None
+    # Real sequence length when the stream is tile-padded (ViT.pad_seq_to);
+    # None = every position is a valid key.
+    valid_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -79,9 +86,17 @@ class MultiHeadAttention(nn.Module):
         )(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         if self.attention_fn is not None:
-            out = self.attention_fn(q, k, v)
+            # Only pass valid_len when set — custom attention fns (ring,
+            # Ulysses) keep their plain (q, k, v) signature.
+            out = (
+                self.attention_fn(q, k, v)
+                if self.valid_len is None
+                else self.attention_fn(q, k, v, valid_len=self.valid_len)
+            )
         else:
-            out = dot_product_attention(q, k, v, dtype=self.dtype)
+            out = dot_product_attention(
+                q, k, v, dtype=self.dtype, valid_len=self.valid_len
+            )
         out = nn.DenseGeneral(dim, axis=(-2, -1), dtype=self.dtype, name="out")(out)
         return nn.Dropout(self.dropout_rate, deterministic=not train)(out)
 
@@ -92,6 +107,7 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
+    valid_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -101,6 +117,7 @@ class EncoderBlock(nn.Module):
             self.dropout_rate,
             dtype=self.dtype,
             attention_fn=self.attention_fn,
+            valid_len=self.valid_len,
         )(y, train=train)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -126,6 +143,14 @@ class ViT(nn.Module):
     # constructing a model never initializes JAX backends (which would break
     # a later jax.distributed.initialize()).
     use_flash: Optional[bool] = False
+    # Pad the token stream (cls + patches) up to this length with zero rows
+    # right after position embedding — ViT-B's T=197 maps poorly onto the
+    # 128-lane MXU/VMEM tiling, and padding to 256 makes every GEMM,
+    # transpose, and score tile in the encoder alignment-friendly. Exact
+    # semantics: pad positions are masked out as attention keys (valid_len),
+    # the head reads token 0, and pad rows influence nothing else (per-token
+    # LN/MLP), so their activations AND gradients are inert. None = off.
+    pad_seq_to: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -156,6 +181,19 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        valid_len = None
+        if self.pad_seq_to is not None and x.shape[1] < self.pad_seq_to:
+            if self.attention_fn is not None:
+                # Fail at the pad site, not deep inside block 1: custom
+                # attention fns (ring/Ulysses adapters) take plain (q, k, v)
+                # and would reject the valid_len kwarg the pad requires.
+                raise ValueError(
+                    "pad_seq_to requires the built-in attention paths "
+                    "(attention_fn=None / use_flash) — a custom attention_fn "
+                    "does not take the valid_len pad mask"
+                )
+            valid_len = x.shape[1]
+            x = jnp.pad(x, ((0, 0), (0, self.pad_seq_to - valid_len), (0, 0)))
         attention_fn = self.attention_fn
         if attention_fn is None and self.use_flash is not False:
             attention_fn = default_attention_fn(self.use_flash)
@@ -166,6 +204,7 @@ class ViT(nn.Module):
                 self.dropout_rate,
                 dtype=self.dtype,
                 attention_fn=attention_fn,
+                valid_len=valid_len,
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x[:, 0]  # class token

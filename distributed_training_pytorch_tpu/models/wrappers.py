@@ -22,7 +22,12 @@ class InputNormalizer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
-        mean = jnp.asarray(self.mean, jnp.float32)
-        std = jnp.asarray(self.std, jnp.float32)
-        x = (x.astype(jnp.float32) / 255.0 - mean) / std
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            mean = jnp.asarray(self.mean, jnp.float32)
+            std = jnp.asarray(self.std, jnp.float32)
+            x = (x.astype(jnp.float32) / 255.0 - mean) / std
+        # float inputs are taken as already normalized (e.g. a val source
+        # whose native decode normalizes in C++) and pass through — the
+        # dispatch is static per input dtype, so mixed uint8-train /
+        # f32-val pipelines trace one implementation each.
         return self.inner(x, train=train)

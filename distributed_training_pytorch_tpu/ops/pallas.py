@@ -265,10 +265,11 @@ def _fwd_call(qt, kt, vt, t_k, causal, bq, bk, interpret):
     )(qt, kt, vt)
 
 
-def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret, valid_len=None):
     b, t, h, d = q.shape
+    t_k = t if valid_len is None else valid_len  # kernels mask keys >= t_k
     qt, kt, vt, bq, bk = _ring_pad(q, k, v, block_q, block_k)
-    o, lse = _fwd_call(qt, kt, vt, t, causal, bq, bk, interpret)
+    o, lse = _fwd_call(qt, kt, vt, t_k, causal, bq, bk, interpret)
     return o[:, :, :t, :], lse[:, :, :, :t], (qt, kt, vt)
 
 
@@ -330,20 +331,23 @@ def _dkv_call(qt, kt, vt, do, lse_p, delta, t_q, t_k, causal, bq, bk, interpret)
     )(qt, kt, vt, do, lse_p, delta)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, valid_len):
+    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret, valid_len)
     return _from_bhtd(o)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse, (qt, kt, vt) = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, valid_len):
+    o, lse, (qt, kt, vt) = _fwd_impl(
+        q, k, v, causal, block_q, block_k, interpret, valid_len
+    )
     return _from_bhtd(o), (qt, kt, vt, o, lse, q.shape)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, valid_len, res, g):
     qt, kt, vt, o, lse, q_shape = res
     b, t, h, d = q_shape
+    t_k = t if valid_len is None else valid_len
     bq = _block_size(block_q, t)
     bk = _block_size(block_k, t)
     tq_pad = qt.shape[2]
@@ -356,8 +360,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     )[:, :, None, :]  # [B, H, 1, Tq_pad]
     lse_p = _pad_to(lse, tq_pad, 3)
 
-    dq = _dq_call(qt, kt, vt, do, lse_p, delta, t, t, causal, bq, bk, interpret)
-    dk, dv = _dkv_call(qt, kt, vt, do, lse_p, delta, t, t, causal, bq, bk, interpret)
+    dq = _dq_call(qt, kt, vt, do, lse_p, delta, t, t_k, causal, bq, bk, interpret)
+    dk, dv = _dkv_call(qt, kt, vt, do, lse_p, delta, t, t_k, causal, bq, bk, interpret)
 
     return (
         _from_bhtd(dq[:, :, :t, :]),
@@ -441,19 +445,28 @@ def flash_attention(
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    valid_len: Optional[int] = None,
 ) -> jax.Array:
     """Fused flash attention on ``[B, T, H, D]`` tensors.
 
     Numerics match ``models.vit.dot_product_attention`` (softmax statistics in
     float32, scale ``D**-0.5``); memory is O(T) per (batch, head) instead of
     the O(T^2) score tensor. ``interpret=None`` auto-selects: compiled on TPU,
-    Pallas interpreter elsewhere (slow — tests only).
+    Pallas interpreter elsewhere (slow — tests only). ``valid_len`` masks key
+    positions >= it — for caller-padded sequences (``ViT.pad_seq_to``); the
+    kernels' own seq_len masking does the work, no score tensor or bias mask
+    is ever built.
     """
     if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"expected matching [B,T,H,D] q/k/v, got {q.shape}/{k.shape}/{v.shape}")
+    if valid_len is not None:
+        if causal:
+            raise ValueError("valid_len composes with non-causal attention only")
+        if not 0 < valid_len <= q.shape[1]:
+            raise ValueError(f"valid_len {valid_len} out of range for T={q.shape[1]}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret, valid_len)
 
 
 # Below this sequence length the plain O(T^2) XLA path wins: the score tensor
@@ -474,12 +487,18 @@ def make_attention_fn(causal: bool = False, min_seq_len: int = FLASH_MIN_SEQ_LEN
     time, so the compiled step contains exactly one implementation.
     """
 
-    def attention_fn(q, k, v):
+    def attention_fn(q, k, v, valid_len=None):
+        if causal and valid_len is not None:
+            # Match flash_attention's guard on the short-T branch too — a
+            # silently dropped valid_len would attend over pad keys.
+            raise ValueError("valid_len composes with non-causal attention only")
         if q.shape[1] < min_seq_len:
             from distributed_training_pytorch_tpu.models.vit import dot_product_attention
 
-            return _causal_plain(q, k, v) if causal else dot_product_attention(q, k, v, dtype=q.dtype)
-        return flash_attention(q, k, v, causal=causal, **kwargs)
+            if causal:
+                return _causal_plain(q, k, v)
+            return dot_product_attention(q, k, v, dtype=q.dtype, valid_len=valid_len)
+        return flash_attention(q, k, v, causal=causal, valid_len=valid_len, **kwargs)
 
     return attention_fn
 

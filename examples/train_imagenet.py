@@ -54,18 +54,32 @@ RECIPES = {
 }
 
 
-def train_transform(image_size: int, seed: int) -> T.Compose:
-    """Random-resized-crop + flip + normalize, Philox-keyed per (epoch, index)
-    — the at-scale analog of the reference's albumentations pipeline
-    (``dataset/example_dataset.py:35-46``)."""
-    return T.Compose(
-        [
-            T.random_resized_crop(image_size, image_size),
-            T.horizontal_flip(),
-            T.normalize(),
-        ],
-        seed=seed,
-    )
+def _ship_uint8() -> bool:
+    """SHIP_UINT8=1 (default): the host pipeline stays uint8 end-to-end and
+    normalization runs on device (models.wrappers.InputNormalizer, fused by
+    XLA into the first conv) — the host->device link carries 4x fewer bytes
+    than pre-normalized float32 and the host skips a float pass (measured
+    2.7x records-path E2E, BASELINE.md). Same math, same augmentation
+    stream; SHIP_UINT8=0 restores host-side normalize.
+
+    NOTE: the wrapper nests the model's params under an ``inner`` scope, so
+    the CHECKPOINT TREE depends on this knob — keep it consistent across a
+    run's save/resume/eval (snapshots from builds before r4, or from
+    SHIP_UINT8=0, restore only with SHIP_UINT8=0)."""
+    return os.environ.get("SHIP_UINT8", "1") != "0"
+
+
+def train_transform(image_size: int, seed: int, ship_uint8: bool = True) -> T.Compose:
+    """Random-resized-crop + flip (+ normalize unless shipping uint8),
+    Philox-keyed per (epoch, index) — the at-scale analog of the reference's
+    albumentations pipeline (``dataset/example_dataset.py:35-46``)."""
+    ops = [
+        T.random_resized_crop(image_size, image_size),
+        T.horizontal_flip(),
+    ]
+    if not ship_uint8:
+        ops.append(T.normalize())
+    return T.Compose(ops, seed=seed)
 
 
 def eval_transform(image_size: int) -> T.Compose:
@@ -111,7 +125,7 @@ class ImageNetTrainer(Trainer):
         super().__init__(**kw)
 
     def build_train_dataset(self):
-        tfm = train_transform(self.image_size, seed=self.seed)
+        tfm = train_transform(self.image_size, seed=self.seed, ship_uint8=_ship_uint8())
         if self.train_records:
             source = RecordFileSource(self.train_records, transform=tfm)
         else:
@@ -136,7 +150,14 @@ class ImageNetTrainer(Trainer):
         return synthetic_source(1024, self.image_size, self.num_classes, tfm, seed=1)
 
     def build_model(self):
-        return create_model(self.model_name, num_classes=self.num_classes, dtype=jnp.bfloat16)
+        model = create_model(self.model_name, num_classes=self.num_classes, dtype=jnp.bfloat16)
+        if _ship_uint8():
+            from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer
+
+            model = InputNormalizer(
+                inner=model, mean=list(T.IMAGENET_MEAN), std=list(T.IMAGENET_STD)
+            )
+        return model
 
     def build_criterion(self):
         def criterion(logits, batch):

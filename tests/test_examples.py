@@ -115,6 +115,7 @@ def test_offline_eval(trained_example, data_root, mesh):
     assert 0.0 <= results["top1"] <= results["top2"] <= 1.0
 
 
+@pytest.mark.slow
 def test_cifar10_synthetic_fallback(tmp_path, mesh):
     from examples.train_cifar10 import Cifar10Trainer, load_cifar10
 

@@ -93,6 +93,7 @@ def mesh(devices):
     return mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
 
 
+@pytest.mark.slow
 def test_resnet_train_step_updates_batch_stats(mesh):
     _smoke(ResNet18Slim(num_classes=10), mesh, has_model_state=True)
 
@@ -101,6 +102,7 @@ def test_vit_train_step(mesh):
     _smoke(ViTTiny(num_classes=10), mesh)
 
 
+@pytest.mark.slow
 def test_convnext_train_step_with_droppath(mesh):
     _smoke(ConvNeXtTiny(num_classes=10, drop_path_rate=0.2), mesh)
 

@@ -152,6 +152,7 @@ def test_engine_establishes_ambient_mesh(devices):
 
 
 @pytest.mark.parametrize("top_k,num_groups", [(1, 1), (2, 1), (2, 2)])
+@pytest.mark.slow
 def test_moe_sort_dispatch_matches_einsum(top_k, num_groups):
     """The argsort/scatter dispatch is semantics-identical to the GShard
     one-hot path: same outputs AND same grads, including under capacity

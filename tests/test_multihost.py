@@ -242,6 +242,7 @@ mesh_lib.shutdown_distributed()
 
 
 @pytest.mark.skipif(os.name != "posix", reason="subprocess workers")
+@pytest.mark.slow
 def test_two_process_full_trainer(tmp_path):
     """Full Trainer.train() across 2 real processes: loader sharding,
     collective validation, collective checkpoint saves, the preemption vote

@@ -88,3 +88,63 @@ def test_mha_with_flash_kernel_matches_plain():
     out_plain = plain.apply(variables, x)
     out_flash = flash.apply(variables, x)
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_plain), atol=2e-5)
+
+
+def test_flash_valid_len_matches_masked_plain():
+    """valid_len (caller-padded sequences) masks exactly like the plain
+    path's key mask — outputs AND gradients, through the custom VJP."""
+    from distributed_training_pytorch_tpu.models.vit import dot_product_attention
+    from distributed_training_pytorch_tpu.ops.pallas import flash_attention
+
+    rng = np.random.RandomState(5)
+    t, valid = 24, 17
+    q = jnp.asarray(rng.randn(2, t, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, t, 4, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, t, 4, 8), jnp.float32)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, valid_len=valid, interpret=True)
+
+    def f_plain(q, k, v):
+        return dot_product_attention(q, k, v, valid_len=valid)
+
+    out_f, out_p = f_flash(q, k, v), f_plain(q, k, v)
+    # Rows past valid_len are inert padding — compare the real rows.
+    np.testing.assert_allclose(
+        np.asarray(out_f[:, :valid]), np.asarray(out_p[:, :valid]), atol=2e-5
+    )
+    # Gradient parity with upstream grads zeroed on pad rows (what a model
+    # whose loss ignores pad rows produces).
+    g = jnp.asarray(rng.randn(2, t, 4, 8), jnp.float32).at[:, valid:].set(0.0)
+    gf = jax.grad(lambda *a: jnp.vdot(f_flash(*a), g), argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda *a: jnp.vdot(f_plain(*a), g), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gp):
+        np.testing.assert_allclose(
+            np.asarray(a[:, :valid]), np.asarray(b[:, :valid]), atol=3e-5
+        )
+
+
+@pytest.mark.slow
+def test_vit_pad_seq_to_exact_semantics():
+    """pad_seq_to changes tiling, not math: same params, same logits and
+    same parameter gradients as the unpadded model."""
+    from distributed_training_pytorch_tpu.models.vit import ViTTiny
+
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(2, 16, 16, 3), jnp.float32)
+    base = ViTTiny(num_classes=3)            # T = 16 patches + cls = 17
+    padded = ViTTiny(num_classes=3, pad_seq_to=24)
+    variables = base.init(jax.random.key(0), x)
+    np.testing.assert_allclose(
+        np.asarray(padded.apply(variables, x)),
+        np.asarray(base.apply(variables, x)),
+        atol=2e-5,
+    )
+
+    def loss(v, m):
+        return jnp.sum(m.apply(v, x) ** 2)
+
+    gb = jax.grad(loss)(variables, base)
+    gp = jax.grad(loss)(variables, padded)
+    for a, b in zip(jax.tree.leaves(gb), jax.tree.leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)

@@ -59,6 +59,7 @@ def test_ulysses_attention_matches_dense(seq_mesh):
     np.testing.assert_allclose(np.asarray(uly), np.asarray(dense), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_causal_matches_ring(seq_mesh):
     q, k, v = qkv((1, 64, 8, 4), seed=3)
     a = ulysses_attention(q, k, v, seq_mesh, causal=True)
@@ -89,6 +90,7 @@ def test_fsdp_spec_shards_largest_divisible_dim(devices):
     assert spec_for_leaf("kernel", (4098, 1024), mesh) == P(None, "fsdp")
 
 
+@pytest.mark.slow
 def test_state_shardings_fsdp_end_to_end(devices):
     """FSDP engine: params actually land sharded, training still works, and
     numerics match the replicated run."""
@@ -188,6 +190,7 @@ def test_tensor_parallel_vit_matches_dp(devices):
     np.testing.assert_allclose(losses_t, losses_d, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_matches_plain(devices):
     """Ulysses with the Pallas kernel for its local attention (interpreter on
     CPU) agrees with the plain local-attention path, fwd and grad."""
@@ -222,6 +225,7 @@ def test_ring_flash_matches_dense_ring(seq_mesh, causal):
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_gradients_match(seq_mesh, causal):
     """The ring-level custom VJP (blockwise flash backward on a reverse ring)

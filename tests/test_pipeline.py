@@ -53,6 +53,7 @@ def test_pipeline_matches_sequential(pipe_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_gradients_match(pipe_mesh):
     stages = make_stages(4, d=8, hidden=16, seed=2)
     stacked = stack_stage_params(stages)
@@ -199,6 +200,7 @@ def test_pipeline_sharded_feed_matches_replicated(pipe_mesh):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_interleaved_gradients_match(pipe_mesh):
     stages = make_stages(8, d=8, hidden=16, seed=11)
     stacked = stack_stage_params(stages)
@@ -219,6 +221,7 @@ def test_pipeline_interleaved_gradients_match(pipe_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_remat_matches(pipe_mesh):
     stages = make_stages(4, d=8, hidden=16, seed=13)
     stacked = stack_stage_params(stages)
@@ -269,6 +272,7 @@ def test_pipeline_embed_blocks_head(pipe_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.stack(ref)), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_end_gradients_flow(pipe_mesh):
     """Grads reach the embed table and head weights through the ring."""
     d, vocab = 8, 16

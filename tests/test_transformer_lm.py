@@ -97,6 +97,7 @@ def test_lm_overfits_with_engine(devices):
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.slow
 def test_ring_attention_impl_matches_plain(devices):
     """attention_impl='ring' over a seq mesh matches the plain causal path."""
     mesh = mesh_lib.create_mesh({mesh_lib.SEQ_AXIS: 4}, devices=devices[:4])
@@ -206,6 +207,7 @@ def test_fused_loss_includes_moe_aux(devices):
     assert float(m["loss"]) > float(m["nll"])  # aux terms actually added
 
 
+@pytest.mark.slow
 def test_moe_lm_cached_decode_and_generate():
     """KV-cache decode works through MoE blocks: with capacity headroom the
     training-time router drops nothing, so the capacity-free decode router

@@ -4,8 +4,9 @@ Walks a compiled executable's ``as_text()`` for every ``convolution`` and
 ``dot`` instruction (fused bodies included — each ``%name`` defines once) and
 computes the FLOPs XLA's own cost model attributes to it: ``2 * out_elems *
 reduction_size``, reduction = rhs spatial x input-feature (convs, from
-``dim_labels``, divided by ``feature_group_count``) or the contracting-dims
-product (dots). The sum is the program's *executed* MXU FLOPs — what the
+``dim_labels`` — the HLO rhs kernel already carries C_in/groups for grouped
+convs, so NO further feature_group_count division; regression-tested) or the
+contracting-dims product (dots). The sum is the program's *executed* MXU FLOPs — what the
 compiler kept after folding, as opposed to the layer-formula *nominal* count
 an eager executor (the torch reference) performs.
 

@@ -111,6 +111,22 @@ if __name__ == "__main__":
         model = create_model(
             os.environ["EVAL_MODEL"], num_classes=len(labels or LABELS)
         )
+        # Checkpoints from examples/train_imagenet.py default (SHIP_UINT8=1)
+        # nest params under InputNormalizer's 'inner' scope; the restore
+        # target must match. Same knob, same default, scoped to the models
+        # that trainer produces — so defaults trained == defaults evaluated;
+        # SHIP_UINT8=0 here for pre-r4 / unwrapped snapshots. (VGG16 runs
+        # from main.py are never wrapped and take the EVAL_MODEL-unset path.)
+        imagenet_family = os.environ["EVAL_MODEL"] in (
+            "resnet50", "vit_b16", "convnext_l", "convnext_tiny"
+        )
+        if imagenet_family and os.environ.get("SHIP_UINT8", "1") != "0":
+            from distributed_training_pytorch_tpu.data import transforms as _T
+            from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer
+
+            model = InputNormalizer(
+                inner=model, mean=list(_T.IMAGENET_MEAN), std=list(_T.IMAGENET_STD)
+            )
     results = evaluate(checkpoint_dir, test_path, labels=labels, model=model)
     print(f"ACCURACY TOP-1: {results['top1']:.4f}")
     print(f"ACCURACY TOP-2: {results['top2']:.4f}")

@@ -134,3 +134,30 @@ def test_droppath_zero_at_eval():
     mod = DropPath(0.99)
     out = mod.apply({}, x, train=False)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_input_normalizer_uint8_vs_float_dispatch():
+    """InputNormalizer normalizes uint8 batches on device and passes float
+    batches through untouched (they arrive pre-normalized, e.g. from the
+    native val decode) — the mixed uint8-train / f32-val contract of
+    examples/train_imagenet.py SHIP_UINT8."""
+    from flax import linen as nn
+
+    from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer
+
+    class Echo(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train=False):
+            return x
+
+    mean, std = [0.5, 0.5, 0.5], [0.25, 0.25, 0.25]
+    model = InputNormalizer(inner=Echo(), mean=mean, std=std)
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, size=(2, 4, 4, 3)).astype(np.uint8)
+    variables = model.init(jax.random.key(0), jnp.asarray(raw))
+    out_u8 = model.apply(variables, jnp.asarray(raw))
+    expect = (raw.astype(np.float32) / 255.0 - np.asarray(mean)) / np.asarray(std)
+    np.testing.assert_allclose(np.asarray(out_u8), expect, atol=1e-6)
+    pre = jnp.asarray(expect)
+    out_f32 = model.apply(variables, pre)
+    np.testing.assert_array_equal(np.asarray(out_f32), np.asarray(pre))

@@ -8,11 +8,14 @@ reference publishes no numbers of its own, BASELINE.md).
 MFU methodology (standard analytic convention, as in the PaLM paper / the
 scaling book): model FLOPs are counted from layer shapes — 2*M*N*K per
 conv/GEMM, backward pass = 2x forward — divided by wall time and the chip's
-peak bf16 FLOP/s. XLA's own ``cost_analysis()`` estimate is reported alongside
-(``mfu_xla``) for transparency; it systematically undercounts the conv
-backward ops, so the analytic number is the headline. Timing is the best of
-``BENCH_WINDOWS`` measured windows on an AOT-compiled step (one compile, no
-retrace; best-of because the shared chip's interference only ever subtracts).
+peak bf16 FLOP/s. That nominal count is the headline (it is the work an
+eager executor like the torch reference performs); ``mfu_exec`` (HLO
+conv/dot recount of what the compiler kept after folding — see
+utils/hlo_flops.py and the r4 itemization in BASELINE.md) and ``mfu_xla``
+(``cost_analysis()``, executed matmuls + VPU elementwise) are reported
+alongside. Timing is the best of ``BENCH_WINDOWS`` measured windows on an
+AOT-compiled step (one compile, no retrace; best-of because the shared
+chip's interference only ever subtracts).
 
 Perf defaults (measured on v5e, see utils/tpu.py): hardware-RBG PRNG for the
 dropout masks (saves ~8% of step time vs threefry), global batch 4096

@@ -21,6 +21,7 @@ the ambient mesh (pass ``mesh=``).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -325,14 +326,26 @@ def generate(
 
     ``prompt`` is ``[B, P]`` int32; returns ``[B, P + num_steps]``. One
     ``lax.scan`` covers prefill and generation — every step is a single-token
-    cached decode (static shapes throughout; jit-compatible).
+    cached decode (static shapes throughout). The whole decode is jitted
+    (model/num_steps/temperature static), so a repeat call with the same
+    shapes is ONE device dispatch — unjitted, ``lax.scan`` re-traces the
+    decoder body on every call, which costs seconds of host time per sample
+    and dominates through a remote-dispatch link.
     ``temperature=0`` is greedy; otherwise softmax sampling at that
     temperature.
     """
+    total = prompt.shape[1] + num_steps
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + steps {num_steps} exceeds max_len {model.max_len}"
+        )
+    return _generate_jit(model, variables, prompt, num_steps, rng, temperature)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 5))
+def _generate_jit(model, variables, prompt, num_steps, rng, temperature):
     b, p = prompt.shape
     total = p + num_steps
-    if total > model.max_len:
-        raise ValueError(f"prompt {p} + steps {num_steps} exceeds max_len {model.max_len}")
     params = {k: v for k, v in variables.items() if k != "cache"}
 
     # The cache initializes to zeros (its variable defaults), so its structure

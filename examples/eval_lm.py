@@ -91,14 +91,29 @@ def evaluate(checkpoint_dir: str, corpus: str, *, size="small", seq_len=256, bat
 
 
 def sample(checkpoint_dir: str, prompt_text: bytes, *, size="small", seq_len=256,
-           gen_steps=64, temperature=0.8, moe_every=0, loaded=None):
+           gen_steps=64, temperature=0.8, moe_every=0, loaded=None,
+           timings: dict | None = None):
     model, params = loaded or load_params(checkpoint_dir, size, seq_len, moe_every)
     prompt = jnp.asarray(np.frombuffer(prompt_text, np.uint8)[None, :], jnp.int32)
     out = {}
-    out["greedy"] = bytes(
-        np.asarray(generate(model, {"params": params}, prompt, gen_steps,
-                            jax.random.key(0)))[0].astype(np.uint8)
-    )
+    variables = {"params": params}
+    key0 = jax.random.key(0)
+    greedy = np.asarray(
+        generate(model, variables, prompt, gen_steps, key0)
+    )  # first call pays the decode-path compile
+    if timings is not None:
+        import time as _time
+
+        jax.block_until_ready(key0)  # only the generate call inside the window
+        t0 = _time.perf_counter()
+        greedy = np.asarray(generate(model, variables, prompt, gen_steps, key0))
+        dt = _time.perf_counter() - t0
+        # The scan runs p-1 prompt-prefill steps PLUS gen_steps generation
+        # steps, all single-token cached decodes — count them all.
+        decode_steps = prompt.shape[1] - 1 + gen_steps
+        timings["decode_tok_per_s"] = decode_steps / dt
+        timings["decode_steps"] = decode_steps
+    out["greedy"] = bytes(greedy[0].astype(np.uint8))
     if temperature > 0:
         out[f"t={temperature}"] = bytes(
             np.asarray(generate(model, {"params": params}, prompt, gen_steps,
@@ -121,10 +136,18 @@ if __name__ == "__main__":
               f"({results['n_windows']} windows)")
     if moe_every == 0:  # generation needs the dense decode path
         prompt = os.environ.get("PROMPT", "").encode() or b"the "
+        timings: dict = {}
         for name, text in sample(
             ckpt, prompt, size=size, seq_len=seq_len,
             gen_steps=int(os.environ.get("GEN_STEPS", "64")),
             temperature=float(os.environ.get("TEMPERATURE", "0.8")), loaded=loaded,
+            timings=timings,
         ).items():
             print(f"--- {name} ---")
             print(text.decode("utf-8", errors="replace"))
+        if timings:
+            # Sequential KV-cache decode rate, batch 1, compile excluded
+            # (serving throughput scales with decode batch; this is the
+            # latency-floor number).
+            print(f"DECODE: {timings['decode_tok_per_s']:.1f} tok/s "
+                  f"(greedy, batch 1, {timings['decode_steps']} single-token steps)")

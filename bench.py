@@ -230,14 +230,15 @@ BENCH_MODELS = {
     "vit": {
         "build": _build_vit,
         "flops": vit_train_flops_per_image,
-        # Per-chip batch swept on v5e (r4): 96 is the optimum — 930 img/s vs
-        # 751 at 256 (the r3 default); 884@64, 894@80, 740@112, 779@128,
-        # 902@160, 932@192 (ties 96), 753@224. Off-optimum batches push XLA
-        # into rematerializing the [B,12,197,197] attention tensors in
-        # backward (profile shows .remat fusions); at 96/192 the live-set
-        # fits and the recompute disappears. In a DP pod the global batch is
-        # 96 x n_chips.
-        "batch": 96,
+        # Per-chip batch swept on v5e (r4): 96 and 192 are the optima — 930/
+        # 932 img/s vs 751 at 256 (the r3 default); 884@64, 894@80, 740@112,
+        # 779@128, 902@160, 753@224. Off-optimum batches push XLA into
+        # rematerializing the [B,12,197,197] attention tensors in backward
+        # (profile shows .remat fusions); at 96/192 the live-set fits and the
+        # recompute disappears. 192 is the default (bigger batch, same
+        # per-image efficiency: full bench measured 949 img/s, 50.8% MFU).
+        # In a DP pod the global batch is 192 x n_chips.
+        "batch": 192,
         "image_size": 224,
         "num_classes": 1000,
         "metric": "images/sec/chip (ViT-B/16, ImageNet-shape, bf16)",

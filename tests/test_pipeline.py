@@ -314,9 +314,10 @@ def test_pipeline_composes_on_one_mesh(devices, combo):
     combo="expert": MoE stages with expert-sharded weights (each tick's MoE
                     einsums are expert-parallel), feed replicated.
     combo="tensor": dense stages whose w1/w2 are Megatron-sharded over the
-                    `tensor` axis (column- then row-parallel) via argument
-                    shardings on the stacked params — GSPMD runs each tick's
-                    MLP tensor-parallel inside the pipe-manual region.
+                    `tensor` axis (column- then row-parallel) via
+                    with_sharding_constraint on the stacked params before
+                    the ring — GSPMD runs each tick's MLP tensor-parallel
+                    inside the pipe-manual region.
 
     All three combos check loss AND gradients against the sequential
     single-device reference. The data x expert x pipe TRIPLE (data-sharded activations
@@ -361,10 +362,10 @@ def test_pipeline_composes_on_one_mesh(devices, combo):
     def pipe_loss(stacked):
         fed = micro
         if combo == "tensor":
-            # Megatron MLP sharding carried by the stacked params' own
-            # shardings through the pipe-manual region's auto axes:
-            # w1 [VS, d, hidden] column-parallel, w2 [VS, hidden, d]
-            # row-parallel over `tensor`.
+            # Megatron MLP sharding constrained on the stacked params
+            # before the ring, carried through the pipe-manual region's
+            # auto axes: w1 [VS, d, hidden] column-parallel, w2
+            # [VS, hidden, d] row-parallel over `tensor`.
             stacked = {
                 "w1": jax.lax.with_sharding_constraint(
                     stacked["w1"],

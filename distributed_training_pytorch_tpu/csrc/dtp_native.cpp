@@ -333,6 +333,44 @@ int64_t dtp_decode_resize_normalize_bytes(
   return failed.load() >= 0 ? failed.load() + 1 : 0;
 }
 
+// Decode + resize only, uint8 out — the ship-uint8 TRAIN path over record
+// payloads: decode -> resize stays uint8, augmentation stays uint8
+// (dtp_augment_crop_flip_u8), normalization runs on device
+// (models.InputNormalizer fuses it into the first conv). The float decode
+// entries above keep host-side normalize for val/eval pipelines.
+struct DecodeU8Args {
+  const uint8_t* const* bufs;
+  const int64_t* lengths;
+  int out_h, out_w;
+  uint8_t* out;
+  std::atomic<int64_t>* failed;
+};
+
+static void decode_u8_one(int64_t i, void* p) {
+  DecodeU8Args* a = (DecodeU8Args*)p;
+  int h = 0, w = 0;
+  uint8_t* img = decode_bytes(a->bufs[i], (size_t)a->lengths[i], &h, &w);
+  if (!img) {
+    int64_t expect = -1;
+    a->failed->compare_exchange_strong(expect, i);
+    return;
+  }
+  bilinear_resize_u8(img, h, w,
+                     a->out + (size_t)i * a->out_h * a->out_w * 3,
+                     a->out_h, a->out_w);
+  free(img);
+}
+
+int64_t dtp_decode_resize_u8_bytes(const uint8_t* const* bufs,
+                                   const int64_t* lengths, int64_t n,
+                                   int out_h, int out_w, uint8_t* out,
+                                   int threads) {
+  std::atomic<int64_t> failed(-1);
+  DecodeU8Args a{bufs, lengths, out_h, out_w, out, &failed};
+  run_parallel(n, threads, decode_u8_one, &a);
+  return failed.load() >= 0 ? failed.load() + 1 : 0;
+}
+
 // Deterministic CIFAR-style augmentation over an in-memory uint8 batch:
 // reflect-pad by `pad`, random crop back to (h, w), optional horizontal
 // flip (p=0.5), normalize. Randomness keyed by (seed, epoch<<40 | index[i]).

@@ -7,6 +7,7 @@ from distributed_training_pytorch_tpu.data import native  # noqa: F401
 from distributed_training_pytorch_tpu.data.loader import ShardedLoader  # noqa: F401
 from distributed_training_pytorch_tpu.data.records import (  # noqa: F401
     NativeRecordFileSource,
+    NativeRecordTrainSource,
     RecordFileSource,
     RecordFileWriter,
     pack_image_folder,

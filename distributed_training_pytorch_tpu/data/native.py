@@ -106,12 +106,26 @@ def _load():
         lib.dtp_decode_resize_normalize_bytes.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), i64ptr, i64, i32, i32, fptr, fptr, fptr, i32,
         ]
+        lib.dtp_decode_resize_u8_bytes.restype = i64
+        lib.dtp_decode_resize_u8_bytes.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), i64ptr, i64, i32, i32, u8ptr, i32,
+        ]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+class DecodeError(ValueError):
+    """A payload in a native decode batch failed; ``index`` is the position
+    within the sequence passed to that call (callers slicing a larger batch
+    remap it — see :func:`mixed_native_batch`)."""
+
+    def __init__(self, index: int, what: str = "record payload"):
+        self.index = index
+        super().__init__(f"failed to decode {what} #{index}")
 
 
 def _threads(n: int | None) -> int:
@@ -173,11 +187,36 @@ def decode_resize_normalize_bytes(
         out, _threads(threads),
     )
     if rc:
-        raise ValueError(f"failed to decode record payload #{rc - 1}")
+        raise DecodeError(rc - 1)
     return out
 
 
-def mixed_native_batch(n, height, width, native_positions, native_fn, py_fn) -> np.ndarray:
+def decode_resize_u8_bytes(
+    payloads: Sequence[bytes],
+    height: int,
+    width: int,
+    *,
+    threads: int | None = None,
+) -> np.ndarray:
+    """In-memory JPEG/PNG payloads -> [N, H, W, 3] uint8 (decode + resize, no
+    normalize) — the ship-uint8 train path; pair with
+    :func:`augment_crop_flip_u8` and on-device ``models.InputNormalizer``."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(payloads)
+    lengths = np.asarray([len(p) for p in payloads], np.int64)
+    bufs = (ctypes.c_char_p * n)(*payloads)
+    out = np.empty((n, height, width, 3), np.uint8)
+    rc = lib.dtp_decode_resize_u8_bytes(bufs, lengths, n, height, width, out, _threads(threads))
+    if rc:
+        raise DecodeError(rc - 1)
+    return out
+
+
+def mixed_native_batch(
+    n, height, width, native_positions, native_fn, py_fn, *, dtype=np.float32
+) -> np.ndarray:
     """Assemble a decoded batch where some rows take the native batch call and
     the rest fall back per record (shared by the folder and record sources).
 
@@ -186,9 +225,14 @@ def mixed_native_batch(n, height, width, native_positions, native_fn, py_fn) -> 
     the stacked native results for those positions; ``py_fn(position)`` one
     fallback row.
     """
-    images = np.empty((n, height, width, 3), np.float32)
+    images = np.empty((n, height, width, 3), dtype)
     if native_positions:
-        images[native_positions] = native_fn(native_positions)
+        try:
+            images[native_positions] = native_fn(native_positions)
+        except DecodeError as e:
+            # remap the subset-relative index to the batch position, so the
+            # error names the record an operator would actually look for
+            raise DecodeError(native_positions[e.index], "batch record") from None
     for p in set(range(n)) - set(native_positions):
         images[p] = py_fn(p)
     return images

@@ -146,6 +146,12 @@ class RecordFileSource:
                 fd = winner
         return fd
 
+    @staticmethod
+    def _native_decodable(payload: bytes) -> bool:
+        # the csrc decoders handle JPEG and PNG; anything else (bmp/webp from
+        # a packed image folder) falls back to the Python path per record
+        return payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n"
+
     def read_record(self, index: int) -> tuple[bytes, int]:
         # os.pread: positioned reads are atomic per call, so loader worker
         # THREADS can share one fd per shard — a seek()+read() pair on a
@@ -166,11 +172,13 @@ class RecordFileSource:
         state["_fds"] = {}
         return state
 
-    def __del__(self):
+    def __del__(self, _close=os.close):
+        # default arg captures os.close — at interpreter shutdown the module's
+        # globals may already be cleared when GC runs this finalizer
         for fd in self.__dict__.get("_fds", {}).values():
             try:
-                os.close(fd)
-            except OSError:
+                _close(fd)
+            except Exception:
                 pass
 
 
@@ -195,12 +203,6 @@ class NativeRecordFileSource(RecordFileSource):
         if self._native is None:
             self.transform = self._py_transform
 
-    @staticmethod
-    def _native_decodable(payload: bytes) -> bool:
-        # the csrc decoders handle JPEG and PNG; anything else (bmp/webp from
-        # a packed image folder) falls back to the Python path per record
-        return payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n"
-
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         from distributed_training_pytorch_tpu.data.native import mixed_native_batch
 
@@ -222,6 +224,118 @@ class NativeRecordFileSource(RecordFileSource):
                 [self._py_transform(self.decode(p)) for p in payloads]
             )
         return {"image": images, "label": labels}
+
+
+class NativeRecordTrainSource(RecordFileSource):
+    """TRAIN-path record source — the full production input pipeline:
+    record payload -> native decode+resize (uint8) -> native deterministic
+    crop/flip augmentation (uint8) -> ship uint8 to device, where
+    ``models.InputNormalizer`` normalizes inside the jitted step (fused into
+    the first conv by XLA; the H2D link carries 1 byte/px instead of 4).
+
+    Capability analog of the reference's train pipeline
+    (``dataset/example_dataset.py:35-60``: cv2 decode + albumentations
+    augment under DataLoader workers), redesigned for the TPU host: one
+    GIL-free C++ call per batch for decode and one for augment, Philox-keyed
+    per (seed, epoch, record index) so augmentation is deterministic across
+    hosts and resumes. Python fallback (same key layout, independent Philox
+    draws — each path deterministic, not bit-identical) when the native
+    library is unavailable.
+
+    ``hflip=False`` for orientation-sensitive corpora (digits/text);
+    ``train=False`` skips augmentation (uint8 val/eval ship)."""
+
+    def __init__(
+        self,
+        pattern: str,
+        height: int,
+        width: int,
+        *,
+        pad: int = 4,
+        seed: int = 0,
+        hflip: bool = True,
+        train: bool = True,
+    ):
+        from distributed_training_pytorch_tpu.data import native
+
+        super().__init__(pattern, transform=None)
+        self.height, self.width = height, width
+        self.pad = pad
+        self.seed = seed
+        self.hflip = hflip
+        self.train = train
+        self._native = native if native.available() else None
+
+    def _decode_u8(self, payloads) -> np.ndarray:
+        """Mixed native/Python decode to a uint8 [N, H, W, 3] batch."""
+        from distributed_training_pytorch_tpu.data.native import mixed_native_batch
+
+        def py_row(p: int) -> np.ndarray:
+            import cv2
+
+            img = self.decode(payloads[p])
+            # cv2 resize keeps uint8; ascontiguousarray for the BGR->RGB view
+            return cv2.resize(
+                np.ascontiguousarray(img), (self.width, self.height),
+                interpolation=cv2.INTER_LINEAR,
+            )
+
+        native_pos = (
+            [p for p, pl in enumerate(payloads) if self._native_decodable(pl)]
+            if self._native is not None
+            else []
+        )
+        return mixed_native_batch(
+            len(payloads),
+            self.height,
+            self.width,
+            native_pos,
+            lambda pos: self._native.decode_resize_u8_bytes(
+                [payloads[p] for p in pos], self.height, self.width
+            ),
+            py_row,
+            dtype=np.uint8,
+        )
+
+    def _augment_py(self, images: np.ndarray, rows: np.ndarray, epoch: int) -> np.ndarray:
+        """Numpy fallback: reflect-pad crop + optional hflip, uint8 -> uint8,
+        keyed like data/transforms.philox_key."""
+        from distributed_training_pytorch_tpu.data.transforms import philox_key
+
+        out = np.empty_like(images)
+        h, w = self.height, self.width
+        for i, idx in enumerate(rows):
+            rng = np.random.Generator(
+                np.random.Philox(key=philox_key(self.seed, epoch, int(idx)))
+            )
+            img = images[i]
+            if self.pad:
+                padded = np.pad(
+                    img, ((self.pad, self.pad), (self.pad, self.pad), (0, 0)),
+                    mode="reflect",
+                )
+                dy, dx = rng.integers(0, 2 * self.pad + 1, size=2)
+                img = padded[dy : dy + h, dx : dx + w]
+            if self.hflip and rng.random() < 0.5:
+                img = img[:, ::-1]
+            out[i] = img
+        return out
+
+    def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
+        payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
+        images = self._decode_u8(payloads)
+        if self.train:
+            idx = np.asarray(rows, np.int64)
+            if self._native is not None:
+                from distributed_training_pytorch_tpu.data.native import augment_crop_flip_u8
+
+                images = augment_crop_flip_u8(
+                    images, idx, pad=self.pad, seed=self.seed, epoch=epoch,
+                    hflip=self.hflip,
+                )
+            else:
+                images = self._augment_py(images, idx, epoch)
+        return {"image": images, "label": np.asarray(labels, np.int32)}
 
 
 def decode_image_bytes(payload: bytes) -> np.ndarray:

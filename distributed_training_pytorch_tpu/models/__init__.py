@@ -32,4 +32,8 @@ def create_model(name: str, num_classes: int, **kwargs):
         return ConvNeXtL(num_classes=num_classes, **kwargs)
     if name in ("convnext-tiny", "convnext_tiny"):
         return ConvNeXtTiny(num_classes=num_classes, **kwargs)
+    if name in ("resnet18_slim", "resnet18-slim"):
+        return ResNet18Slim(num_classes=num_classes, **kwargs)
+    if name in ("vit_tiny", "vit-tiny"):
+        return ViTTiny(num_classes=num_classes, **kwargs)
     raise ValueError(f"unknown model {name!r}")

@@ -14,7 +14,18 @@ class InputNormalizer(nn.Module):
     ``(x/255 - mean)/std`` runs inside the jitted step, where XLA fuses it
     into the first conv — and the host->device link carries uint8 (4x fewer
     bytes than pre-normalized float32). Pair with the uint8 loader path
-    (``data.native.NativeCropFlipU8``)."""
+    (``data.native.NativeCropFlipU8`` / ``data.NativeRecordTrainSource``).
+
+    Input contract (dispatch is static per input dtype):
+
+    * **integer** input — raw 0-255 pixels; normalized here on device.
+    * **float** input — taken as ALREADY normalized (e.g. a val source whose
+      native decode normalizes in C++) and passed through untouched. Feeding
+      un-normalized float32 0-255 images trains on a ~100x-misscaled input
+      with no error from this wrapper; the ``Trainer`` emits a one-time
+      warning when a float image batch's value range looks like raw pixels
+      (``trainer.Trainer._check_image_range``).
+    """
 
     inner: nn.Module
     mean: Sequence[float]

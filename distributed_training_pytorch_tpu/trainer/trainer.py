@@ -346,7 +346,8 @@ class Trainer:
         step_in_epoch = 0
         t0 = time.perf_counter()
         batches = device_prefetch(
-            (self.preprocess_batch(b) for b in self.train_dataloader), self.mesh
+            (self._check_image_range(self.preprocess_batch(b)) for b in self.train_dataloader),
+            self.mesh,
         )
         bar = self._progress_bar(len(self.train_dataloader), f"epoch {epoch + 1}")
         self._epoch_interrupted = False
@@ -556,6 +557,29 @@ class Trainer:
         """Host-side batch hook. The reference uses this for the H2D copy
         (``example_trainer.py:68-70``); here transfer is the framework's job,
         so the default is identity."""
+        return batch
+
+    _image_range_checked = False
+
+    def _check_image_range(self, batch: Mapping) -> Mapping:
+        """One-time foot-gun guard (first train batch only): a FLOAT image
+        batch whose values span raw-pixel range almost certainly missed its
+        normalize — ``models.InputNormalizer`` passes floats through as
+        already normalized, so the model would train on ~100x-misscaled
+        input with no error anywhere else."""
+        if not self._image_range_checked:
+            self._image_range_checked = True
+            img = batch.get("image") if hasattr(batch, "get") else None
+            if img is not None and np.issubdtype(np.asarray(img).dtype, np.floating):
+                hi = float(np.max(np.abs(np.asarray(img[:1]))))
+                if hi > 16.0:  # normalized images sit within a few sigma of 0
+                    self.log(
+                        f"float image batch spans |x| up to {hi:.0f} — looks like "
+                        "raw 0-255 pixels. Float inputs bypass on-device "
+                        "normalization (InputNormalizer passes them through); "
+                        "ship uint8 or normalize on host.",
+                        "warning",
+                    )
         return batch
 
     def train_step(self, state, batch):

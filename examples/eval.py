@@ -118,7 +118,8 @@ if __name__ == "__main__":
         # SHIP_UINT8=0 here for pre-r4 / unwrapped snapshots. (VGG16 runs
         # from main.py are never wrapped and take the EVAL_MODEL-unset path.)
         imagenet_family = os.environ["EVAL_MODEL"] in (
-            "resnet50", "vit_b16", "convnext_l", "convnext_tiny"
+            "resnet50", "vit_b16", "convnext_l", "convnext_tiny",
+            "resnet18_slim", "vit_tiny",
         )
         if imagenet_family and os.environ.get("SHIP_UINT8", "1") != "0":
             from distributed_training_pytorch_tpu.data import transforms as _T
@@ -127,6 +128,11 @@ if __name__ == "__main__":
             model = InputNormalizer(
                 inner=model, mean=list(_T.IMAGENET_MEAN), std=list(_T.IMAGENET_STD)
             )
-    results = evaluate(checkpoint_dir, test_path, labels=labels, model=model)
+    # EVAL_SIZE overrides the 224x224 default (e.g. 32 for the records-path
+    # digits proof's ResNet18Slim checkpoints).
+    size = int(os.environ.get("EVAL_SIZE", "0")) or None
+    results = evaluate(
+        checkpoint_dir, test_path, labels=labels, model=model, height=size, width=size
+    )
     print(f"ACCURACY TOP-1: {results['top1']:.4f}")
     print(f"ACCURACY TOP-2: {results['top2']:.4f}")

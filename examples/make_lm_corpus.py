@@ -16,25 +16,42 @@ from __future__ import annotations
 import os
 import sys
 
-# Real text roots, preference order: stdlib source (prose-rich docstrings),
-# then package docs/READMEs. Sorted traversal => deterministic corpus.
-ROOTS = [
-    ("/usr/lib/python3.11", (".py",)),
-    ("/opt/venv/lib/python3.12/site-packages/numpy", (".py", ".rst", ".txt")),
-    ("/opt/venv/lib/python3.12/site-packages/jax", (".py",)),
-]
+def _roots() -> list[tuple[str, tuple[str, ...]]]:
+    """Real text roots, preference order: stdlib source (prose-rich
+    docstrings), then installed-package docs. Derived from the running
+    interpreter (sysconfig / site), not hardcoded image paths — portable
+    across hosts. Sorted traversal => deterministic corpus."""
+    import site
+    import sysconfig
+
+    roots: list[tuple[str, tuple[str, ...]]] = []
+    stdlib = sysconfig.get_paths().get("stdlib")
+    if stdlib:
+        roots.append((stdlib, (".py",)))
+    site_dirs: list[str] = []
+    try:
+        site_dirs = site.getsitepackages()
+    except AttributeError:  # some embedded interpreters
+        pass
+    for d in site_dirs:
+        for pkg, exts in (("numpy", (".py", ".rst", ".txt")), ("jax", (".py",))):
+            p = os.path.join(d, pkg)
+            if os.path.isdir(p):
+                roots.append((p, exts))
+    return roots
 
 
 def collect(max_bytes: int) -> bytes:
     chunks: list[bytes] = []
     total = 0
-    for root, exts in ROOTS:
+    for root, exts in _roots():
         if total >= max_bytes or not os.path.isdir(root):
             continue
         for dirpath, dirnames, filenames in os.walk(root):
-            dirnames.sort()
-            if "__pycache__" in dirpath or "/test" in dirpath:
-                continue
+            # prune skipped subtrees in place so os.walk never descends
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__" and not d.startswith("test")
+            )
             for name in sorted(filenames):
                 if not name.endswith(tuple(exts)):
                     continue
@@ -63,6 +80,14 @@ def main():
     out = sys.argv[1] if len(sys.argv) > 1 else "./runs/lm_corpus.txt"
     max_mb = float(sys.argv[2]) if len(sys.argv) > 2 else 24.0
     data = collect(int(max_mb * 1e6))
+    # A near-empty corpus "succeeds" here but fails obscurely in train_lm
+    # (0 windows) — fail loudly at the source instead.
+    minimum = min(int(max_mb * 1e6) // 4, 1_000_000)
+    if len(data) < minimum:
+        raise SystemExit(
+            f"collected only {len(data):,} bytes (< {minimum:,}) — no usable "
+            "text roots found on this host (checked stdlib + site-packages)"
+        )
     os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
     with open(out, "wb") as f:
         f.write(data)

@@ -166,6 +166,17 @@ class RecordFileSource:
         payload, label = self.read_record(int(index))
         return {"image": self.decode(payload), "label": np.int32(label)}
 
+    def describe(self, index: int) -> str:
+        """Human-locatable name for record ``index`` — shard path + position
+        inside it (decode-error messages; a batch position alone is useless
+        after the epoch shuffle)."""
+        shard, local = self._locate(int(index))
+        return f"record {int(index)} ({self.paths[shard]} #{local})"
+
+    def _raise_located(self, e, rows):
+        """Re-raise a batch-position DecodeError naming the actual record."""
+        raise ValueError(f"failed to decode {self.describe(int(rows[e.index]))}") from None
+
     def __getstate__(self):
         # fds are not picklable; worker processes reopen lazily.
         state = dict(self.__dict__)
@@ -209,16 +220,21 @@ class NativeRecordFileSource(RecordFileSource):
         payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
         labels = np.asarray(labels, np.int32)
         if self._native is not None:
-            images = mixed_native_batch(
-                len(rows),
-                self.height,
-                self.width,
-                [p for p, pl in enumerate(payloads) if self._native_decodable(pl)],
-                lambda pos: self._native.decode_resize_normalize_bytes(
-                    [payloads[p] for p in pos], self.height, self.width, self.mean, self.std
-                ),
-                lambda p: self._py_transform(self.decode(payloads[p])),
-            )
+            from distributed_training_pytorch_tpu.data.native import DecodeError
+
+            try:
+                images = mixed_native_batch(
+                    len(rows),
+                    self.height,
+                    self.width,
+                    [p for p, pl in enumerate(payloads) if self._native_decodable(pl)],
+                    lambda pos: self._native.decode_resize_normalize_bytes(
+                        [payloads[p] for p in pos], self.height, self.width, self.mean, self.std
+                    ),
+                    lambda p: self._py_transform(self.decode(payloads[p])),
+                )
+            except DecodeError as e:
+                self._raise_located(e, rows)
         else:
             images = np.stack(
                 [self._py_transform(self.decode(p)) for p in payloads]
@@ -322,8 +338,13 @@ class NativeRecordTrainSource(RecordFileSource):
         return out
 
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
+        from distributed_training_pytorch_tpu.data.native import DecodeError
+
         payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
-        images = self._decode_u8(payloads)
+        try:
+            images = self._decode_u8(payloads)
+        except DecodeError as e:
+            self._raise_located(e, rows)
         if self.train:
             idx = np.asarray(rows, np.int64)
             if self._native is not None:

@@ -180,3 +180,141 @@ def test_params_only_restore_across_prng_impls(tmp_path, shared):
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded-state checkpointing (r4 VERDICT item 4): FSDP+TP-sharded TrainState
+# round-trips, including onto a DIFFERENT mesh topology — the pod-scale resume
+# capability (ref trainer/trainer.py:96-101 once params are sharded).
+
+
+def _vit_engine(devices, axes, *, rules=None, min_size=2**18, seed=0, steps=0):
+    from distributed_training_pytorch_tpu.models import ViTTiny
+
+    mesh = mesh_lib.create_mesh(axes, devices=devices)
+    model = ViTTiny(num_classes=4)
+
+    def criterion(logits, batch):
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"loss": loss}
+
+    engine = TrainEngine(
+        make_supervised_loss(model, criterion),
+        optax.sgd(0.05, momentum=0.9),
+        mesh,
+        sharding_rules=rules,
+        fsdp_min_size=min_size,
+    )
+    state = engine.init_state(
+        jax.random.key(seed), lambda r: model.init(r, jnp.zeros((1, 16, 16, 3)))
+    )
+    for i in range(steps):  # make step/opt-state momentum non-trivial
+        rng = np.random.RandomState(i)
+        batch = engine.shard_batch(
+            {
+                "image": rng.randn(8, 16, 16, 3).astype(np.float32),
+                "label": rng.randint(0, 4, size=(8,)).astype(np.int32),
+            }
+        )
+        state, _ = engine.train_step(state, batch)
+    return engine, state
+
+
+def _leaves_equal(a_state, b_state, *, opt=True):
+    for a, b in zip(jax.tree.leaves(a_state.params), jax.tree.leaves(b_state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if opt:
+        for a, b in zip(jax.tree.leaves(a_state.opt_state), jax.tree.leaves(b_state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+SHARDED_AXES = {mesh_lib.DATA_AXIS: 2, mesh_lib.FSDP_AXIS: 2, mesh_lib.TENSOR_AXIS: 2}
+
+
+@pytest.mark.slow
+def test_sharded_roundtrip_same_mesh(tmp_path, devices):
+    """FSDP+TP-sharded state (momentum + step included) survives save/restore
+    onto the same mesh, and the restored leaves land with the target's
+    shardings (not replicated)."""
+    from distributed_training_pytorch_tpu.parallel.sharding import transformer_tp_rules
+
+    engine, state = _vit_engine(
+        devices, SHARDED_AXES, rules=transformer_tp_rules(), min_size=1024, steps=2
+    )
+    specs = [
+        str(l.sharding.spec) for l in jax.tree.leaves(state.params) if hasattr(l, "sharding")
+    ]
+    assert any("fsdp" in s for s in specs) and any("tensor" in s for s in specs), specs
+
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    mgr.save(LAST, state, epoch=3)
+    mgr.close()
+
+    engine2, target = _vit_engine(
+        devices, SHARDED_AXES, rules=transformer_tp_rules(), min_size=1024, seed=1
+    )
+    mgr2 = CheckpointManager(tmp_path / "c", async_save=False)
+    restored, epoch = mgr2.restore(LAST, target)
+    mgr2.close()
+    assert epoch == 3
+    assert int(restored.step) == 2
+    _leaves_equal(state, restored)
+    # restored leaves keep the engine's sharded layout
+    r_specs = [
+        str(l.sharding.spec) for l in jax.tree.leaves(restored.params) if hasattr(l, "sharding")
+    ]
+    assert any("fsdp" in s for s in r_specs) and any("tensor" in s for s in r_specs), r_specs
+    # and the engine can keep training from the restored state on its mesh
+    rng = np.random.RandomState(9)
+    batch = engine2.shard_batch(
+        {
+            "image": rng.randn(8, 16, 16, 3).astype(np.float32),
+            "label": rng.randint(0, 4, size=(8,)).astype(np.int32),
+        }
+    )
+    stepped, m = engine2.train_step(restored, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert int(stepped.step) == 3
+
+
+@pytest.mark.slow
+def test_sharded_restore_onto_different_topology(tmp_path, devices):
+    """A checkpoint saved from an 8-device data*fsdp*tensor mesh restores onto
+    (a) a 4-device fsdp*tensor mesh and (b) a single-device replicated mesh —
+    the resume-after-resize capability at pod scale."""
+    from distributed_training_pytorch_tpu.parallel.sharding import transformer_tp_rules
+
+    _, state = _vit_engine(
+        devices, SHARDED_AXES, rules=transformer_tp_rules(), min_size=1024, steps=2
+    )
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    mgr.save(LAST, state, epoch=5)
+    mgr.close()
+
+    # (a) fewer devices, different axis shape
+    engine4, target4 = _vit_engine(
+        devices[:4],
+        {mesh_lib.FSDP_AXIS: 2, mesh_lib.TENSOR_AXIS: 2},
+        rules=transformer_tp_rules(),
+        min_size=1024,
+        seed=2,
+    )
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    restored4, epoch = mgr.restore(LAST, target4)
+    assert epoch == 5
+    _leaves_equal(state, restored4)
+    batch_rng = np.random.RandomState(3)
+    batch = engine4.shard_batch(
+        {
+            "image": batch_rng.randn(4, 16, 16, 3).astype(np.float32),
+            "label": batch_rng.randint(0, 4, size=(4,)).astype(np.int32),
+        }
+    )
+    _, m = engine4.train_step(restored4, batch)
+    assert np.isfinite(float(m["loss"]))
+
+    # (b) single device, fully replicated target
+    _, target1 = _vit_engine(devices[:1], {mesh_lib.DATA_AXIS: 1}, seed=3)
+    restored1, _ = mgr.restore(LAST, target1)
+    mgr.close()
+    _leaves_equal(state, restored1)

@@ -210,9 +210,12 @@ def test_native_train_source_uint8_deterministic(tmp_path):
     b2 = next(iter(loader))
     assert b1["image"].dtype == np.uint8 and b1["image"].shape == (8, 32, 32, 3)
     np.testing.assert_array_equal(b1["image"], b2["image"])
-    loader.set_epoch(1)
-    b3 = next(iter(loader))
-    assert not np.array_equal(b1["image"], b3["image"]), "epoch must vary the augmentation"
+    # FIXED rows at two epochs (a loader iter would also reshuffle, which
+    # would mask an augmenter that ignores epoch): same records, new crops.
+    e0 = src.load_batch(np.arange(8), epoch=0)
+    e1 = src.load_batch(np.arange(8), epoch=1)
+    np.testing.assert_array_equal(e0["label"], e1["label"])
+    assert not np.array_equal(e0["image"], e1["image"]), "epoch must vary the augmentation"
 
     # decode parity with the Python (cv2) fallback — augmentation off
     src_n = NativeRecordTrainSource(str(tmp_path), 32, 32, pad=0, seed=1, train=False)
@@ -283,5 +286,10 @@ def test_mixed_batch_decode_error_names_batch_position(tmp_path):
     items = [(bmp.getvalue(), 0), (good, 1), (truncated, 2), (good, 3)]
     write_shards(str(tmp_path / "t"), items, num_shards=1)
     src = NativeRecordTrainSource(str(tmp_path), 8, 8, pad=0, train=False)
-    with pytest.raises(native.DecodeError, match="#2"):
+    # the message names the GLOBAL record index + its shard file, not a
+    # position inside the (shuffled) batch or the native-decodable subset
+    with pytest.raises(ValueError, match=r"record 2 \(.*\.rec #2\)"):
         src.load_batch(np.arange(4), epoch=0)
+    # shuffled rows: still the same record named, by its global identity
+    with pytest.raises(ValueError, match=r"record 2 \("):
+        src.load_batch(np.array([3, 2, 1, 0]), epoch=0)

@@ -69,3 +69,55 @@ def test_executed_guard_rejects_unreconciled_counts():
         xla = float(cost.get("flops", 0.0))
         if xla:
             assert 0.3 <= got / xla <= 1.1
+
+
+def test_parser_regression_warns_loudly():
+    """Zero matched conv/dot instructions in a program whose cost_analysis
+    reports real FLOPs = the HLO print format changed — a warning, not a
+    silent None misread as the windowed-conv convention case (ADVICE r4)."""
+    import warnings
+
+    class FakeCompiled:
+        def as_text(self):
+            return "HloModule m\n%root = f32[8]{0} weird-new-op(%x)\n"
+
+        def cost_analysis(self):
+            return {"flops": 5e12}
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert executed_matmul_flops(FakeCompiled()) is None
+    assert any("parser" in str(x.message) for x in w), [str(x.message) for x in w]
+
+    class FakeSmall(FakeCompiled):
+        def cost_analysis(self):
+            return {"flops": 12.0}  # trivial program: silence is fine
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert executed_matmul_flops(FakeSmall()) is None
+    assert not w
+
+
+def test_partial_parser_break_warns_on_undercount():
+    """A below-band nonzero sum (one regex breaking while the other matches)
+    is an undercount the windowed-conv case cannot produce — it warns."""
+    import warnings
+
+    class FakePartial:
+        def as_text(self):
+            # one real-looking dot (256x256x256) in a program whose
+            # cost_analysis claims far more
+            return (
+                "HloModule m\n"
+                "%a = f32[256,256]{1,0} parameter(0)\n"
+                "%d = f32[256,256]{1,0} dot(%a, %a), lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+            )
+
+        def cost_analysis(self):
+            return {"flops": 1e12}
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert executed_matmul_flops(FakePartial()) is None
+    assert any("UNDER-count" in str(x.message) for x in w)

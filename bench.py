@@ -562,6 +562,72 @@ def main():
 
     exec_step_flops = executed_matmul_flops(compiled if chain else probe)
 
+    # ViT remat-cliff guard (r4 VERDICT item 6): config 4's 50.8% MFU rests
+    # on batch 192 sitting on the good side of XLA's backward-remat threshold
+    # (r4 sweep: 932@192 vs 751@256, 753@224 — a +-20% compiler-heuristic
+    # cliff a jax/libtpu upgrade is free to move). Probe: time the SAME
+    # chained-executable shape as the main measurement (same steps, same
+    # best-of reduction — an asymmetric window would bias the ratio by relay
+    # dispatch/interference, masking a real shift) at a known-cliff batch;
+    # if the default batch's per-image step time no longer beats it by the
+    # expected margin, the heuristic moved — warn loudly and ship the probe
+    # numbers in the JSON so a regression is a diff in BENCH_r{N}.json, not a
+    # silent miss. BENCH_CLIFF_PROBE=0 skips (one extra ~35 s compile).
+    # Gated to the calibrated default config: a BENCH_BATCH/BENCH_IMAGE_SIZE
+    # override moves the sweep the 224-cliff point came from (and a 384px
+    # batch-224 probe would also be a memory hazard).
+    cliff_probe = {}
+    if (
+        model_name == "vit"
+        and chain
+        and os.environ.get("BENCH_CLIFF_PROBE", "1") != "0"
+        and "BENCH_BATCH" not in os.environ
+        and "BENCH_IMAGE_SIZE" not in os.environ
+    ):
+        cliff_batch = int(os.environ.get("BENCH_CLIFF_BATCH", "224"))
+        probe_rng = np.random.RandomState(7)
+        probe_host = cfg["make_batch"](
+            probe_rng, cliff_batch, image_size, cfg["num_classes"], setup["model"]
+        )
+        probe_gbatch = engine.shard_batch(probe_host)
+        probe_exec = engine.compile_chained_train_steps(
+            state, probe_gbatch, steps, compiler_options=opts
+        )
+        st, pm = probe_exec(state, probe_gbatch)  # warm
+        _ = float(pm["loss"])
+        probe_windows = min(3, windows)
+        probe_per_step = []
+        for w in range(probe_windows):
+            if w:
+                time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
+            t0 = time.perf_counter()
+            st, pm = probe_exec(st, probe_gbatch)
+            _ = float(pm["loss"])
+            probe_per_step.append((time.perf_counter() - t0) / steps)
+        probe_dt = (
+            float(np.median(probe_per_step)) if reduce == "median" else min(probe_per_step)
+        )
+        del st, probe_exec, probe_gbatch
+        per_img_main = dt / batch
+        per_img_cliff = probe_dt / cliff_batch
+        advantage = per_img_cliff / per_img_main  # healthy r4 sweep: ~1.24
+        cliff_probe = {
+            "cliff_batch": cliff_batch,
+            "cliff_img_per_s": round(cliff_batch / probe_dt, 2),
+            "cliff_advantage": round(advantage, 4),
+        }
+        if advantage < 1.05:
+            print(
+                f"bench: ViT remat-cliff guard FIRED — batch {batch} is only "
+                f"{advantage:.3f}x faster per image than cliff batch "
+                f"{cliff_batch} (healthy margin ~1.2x). XLA's backward-"
+                "remat threshold likely moved under a compiler upgrade; "
+                "re-sweep BENCH_BATCH (r4: optima at 96 and 192).",
+                file=sys.stderr,
+            )
+            cliff_probe["cliff_guard_fired"] = True
+
+
     # BENCH_E2E=1: also run the input-pipeline-fed epoch loop and report it
     # next to the device-step number (VERDICT r2 item 2; r3 item 5 extends
     # it beyond vgg16 to the records path of configs 3-5).
@@ -660,16 +726,24 @@ def main():
                 # analytic count) and counts the fused tied-CE vocab-chunk
                 # scan body once (21%), so mfu_xla structurally reads ~0.66x
                 # mfu on this config — an accounting convention, not perf.
-                # Only when the auto-route actually picks the flash kernel
-                # (T >= 512); below that the LM runs plain attention and
-                # cost_analysis DOES count the attention matmuls.
+                # The tied-CE vocab-scan undercount applies to every LM run;
+                # the flash custom-call exclusion only once the auto-route
+                # picks the kernel (T >= 512 — below that attention runs
+                # plain and cost_analysis DOES count its matmuls).
                 **(
-                    {"mfu_xla_note": "excludes flash custom-call + tied-CE scan trips; see BASELINE.md"}
-                    if model_name == "lm" and image_size >= 512
+                    {
+                        "mfu_xla_note": (
+                            "excludes flash custom-call + tied-CE scan trips; see BASELINE.md"
+                            if image_size >= 512
+                            else "counts tied-CE vocab scan body once; see BASELINE.md"
+                        )
+                    }
+                    if model_name == "lm"
                     else {}
                 ),
                 "batch": batch,
                 "step_ms": round(dt * 1e3, 2),
+                **cliff_probe,
                 **e2e,
             }
         )

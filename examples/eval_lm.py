@@ -125,6 +125,37 @@ def sample(checkpoint_dir: str, prompt_text: bytes, *, size="small", seq_len=256
     return out
 
 
+def decode_benchmark(model, params, *, prompt_len=32, gen_steps=128,
+                     batches=(1, 8, 32, 128)) -> list[dict]:
+    """Batched KV-cache decode throughput (r4 VERDICT item 8): time greedy
+    ``generate`` at several decode batch sizes and report aggregate tok/s and
+    per-stream rate. One compile per batch size (shape change); the timed
+    window is the second call. Single-token decode is HBM-bandwidth-bound
+    (every step streams the full param set), so aggregate tok/s should rise
+    nearly linearly with batch until the cache/weights traffic saturates —
+    this measures where, instead of claiming it."""
+    import time as _time
+
+    variables = {"params": params}
+    rows = []
+    base = jnp.arange(prompt_len, dtype=jnp.int32)[None, :] % 200 + 32
+    for b in batches:
+        prompt = jnp.broadcast_to(base, (b, prompt_len))
+        key = jax.random.key(0)
+        np.asarray(generate(model, variables, prompt, gen_steps, key))  # compile+warm
+        t0 = _time.perf_counter()
+        np.asarray(generate(model, variables, prompt, gen_steps, key))
+        dt = _time.perf_counter() - t0
+        steps = prompt_len - 1 + gen_steps  # prefill + generation, all cached
+        rows.append({
+            "batch": b,
+            "tok_per_s": b * steps / dt,
+            "tok_per_s_per_stream": steps / dt,
+            "step_ms": dt / steps * 1e3,
+        })
+    return rows
+
+
 if __name__ == "__main__":
     ckpt = sys.argv[1] if len(sys.argv) > 1 else "./runs/lm/weights/last"
     corpus = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("LM_CORPUS", "")
@@ -155,3 +186,20 @@ if __name__ == "__main__":
         # latency-floor number).
         print(f"DECODE: {timings['decode_tok_per_s']:.1f} tok/s "
               f"(greedy, batch 1, {timings['decode_steps']} single-token steps)")
+    # DECODE_BATCHES="1,8,32,128": measure batched decode throughput instead
+    # of claiming it scales (BASELINE.md decode table). DECODE_GEN_STEPS sets
+    # the timing window independently of the sampling GEN_STEPS — the
+    # per-step rate is window-length sensitive (dispatch amortization), so
+    # table rows must come from a fixed window.
+    if os.environ.get("DECODE_BATCHES"):
+        batches = tuple(int(x) for x in os.environ["DECODE_BATCHES"].split(","))
+        model, params = loaded
+        for row in decode_benchmark(
+            model, params, gen_steps=int(os.environ.get("DECODE_GEN_STEPS", "128")),
+            batches=batches,
+        ):
+            print(
+                f"DECODE_BATCH {row['batch']:4d}: {row['tok_per_s']:9.1f} tok/s "
+                f"aggregate, {row['tok_per_s_per_stream']:7.1f} tok/s/stream, "
+                f"{row['step_ms']:.2f} ms/step"
+            )

@@ -77,3 +77,15 @@ def test_sample_produces_prompt_prefixed_bytes(tiny_checkpoint):
     for text in out.values():
         assert text.startswith(b"hello ")
         assert len(text) == len(b"hello ") + 6
+
+
+def test_decode_benchmark_batches(tiny_checkpoint):
+    """decode_benchmark times several decode batch sizes through the jitted
+    KV-cache generate path and reports consistent aggregate/per-stream rates."""
+    ev = _load_module()
+    model, params = ev.load_params(tiny_checkpoint, "tiny", 64)
+    rows = ev.decode_benchmark(model, params, prompt_len=8, gen_steps=8, batches=(1, 4))
+    assert [r["batch"] for r in rows] == [1, 4]
+    for r in rows:
+        assert r["tok_per_s"] > 0
+        assert abs(r["tok_per_s"] - r["batch"] * r["tok_per_s_per_stream"]) < 1e-6

@@ -245,9 +245,16 @@ BENCH_MODELS = {
         "metric": "images/sec/chip (ViT-B/16, ImageNet-shape, bf16)",
     },
     "resnet50": {
+        # BENCH_PALLAS_1X1=1: every 1x1 conv runs the Pallas GEMM kernel
+        # (models.resnet.PallasConv1x1) instead of XLA's conv — the r5 probe
+        # measured the kernel at 72% vs XLA's 45% of the HBM bandwidth floor
+        # on the stage-1 shapes (BASELINE.md "ResNet-50" r5 section).
         "build": lambda n, size: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ResNet50"]
-        ).ResNet50(num_classes=n, dtype=jnp.bfloat16),
+        ).ResNet50(
+            num_classes=n, dtype=jnp.bfloat16,
+            pallas_1x1=os.environ.get("BENCH_PALLAS_1X1", "0") == "1",
+        ),
         "flops": resnet_train_flops_per_image,
         "batch": 256,
         "image_size": 224,

@@ -25,18 +25,65 @@ from flax import linen as nn
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 
-class BottleneckBlock(nn.Module):
-    """1x1 reduce -> 3x3 -> 1x1 expand (x4), residual add, post-add ReLU."""
+class PallasConv1x1(nn.Module):
+    """1x1 conv as a Pallas GEMM (``ops.pallas.conv1x1_bn_act_diff`` with an
+    identity epilogue) — the r5 probe measured XLA's conv kernel at ~45% of
+    the HBM bandwidth floor on ResNet stage-1's 56x56x(64<->256) shapes while
+    the hand-tiled GEMM reaches ~72% (BASELINE.md "ResNet-50" r5 row); this
+    module swaps the bandwidth-bound 1x1s onto that kernel. Kernel param
+    keeps nn.Conv's ``[1, 1, Cin, Cout]`` layout; stride subsamples rows
+    before the GEMM (a strided 1x1 conv reads only those pixels)."""
 
     features: int
     strides: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act_diff
+
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", conv_kernel_init, (1, 1, cin, self.features), jnp.float32
+        )
+        if self.strides > 1:
+            x = x[:, :: self.strides, :: self.strides, :]
+        return conv1x1_bn_act_diff(
+            x.astype(self.dtype),
+            kernel.reshape(cin, self.features).astype(self.dtype),
+            jnp.ones((self.features,), jnp.float32),
+            jnp.zeros((self.features,), jnp.float32),
+            relu=False,
+            affine_grads=False,  # identity epilogue: constants, not params
+        )
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (x4), residual add, post-add ReLU."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    pallas_1x1: bool = False
+
+    @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
         conv = partial(
             nn.Conv, use_bias=False, dtype=self.dtype, kernel_init=conv_kernel_init
         )
+
+        def conv1x1(features, strides=1):
+            def apply(inp):
+                # Kernel only where the GEMM is bandwidth-bound (stage-1's
+                # 56x56 maps, ~28 FLOP/byte); the deeper stages' 1x1s are
+                # compute-bound and XLA's conv + fusion wins there.
+                if self.pallas_1x1 and inp.shape[1] >= 56:
+                    return PallasConv1x1(
+                        features, strides=strides, dtype=self.dtype
+                    )(inp)
+                return conv(features, (1, 1), strides=(strides, strides))(inp)
+
+            return apply
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -46,17 +93,15 @@ class BottleneckBlock(nn.Module):
             param_dtype=jnp.float32,
         )
         residual = x
-        y = conv(self.features, (1, 1))(x)
+        y = conv1x1(self.features)(x)
         y = nn.relu(norm()(y))
         y = conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
         y = nn.relu(norm()(y))
-        y = conv(self.features * 4, (1, 1))(y)
+        y = conv1x1(self.features * 4)(y)
         # Zero-init the last BN scale: identity residual at init (He et al.).
         y = norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
-            residual = conv(
-                self.features * 4, (1, 1), strides=(self.strides, self.strides)
-            )(residual)
+            residual = conv1x1(self.features * 4, strides=self.strides)(residual)
             residual = norm()(residual)
         return nn.relu(residual + y)
 
@@ -68,6 +113,9 @@ class ResNet(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     width: int = 64
     dtype: Any = jnp.float32
+    # Route every 1x1 conv through the Pallas GEMM (PallasConv1x1). Changes
+    # the param tree (module names), so flip only on fresh inits.
+    pallas_1x1: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -97,6 +145,7 @@ class ResNet(nn.Module):
                     self.width * (2**stage),
                     strides=2 if stage > 0 and block == 0 else 1,
                     dtype=self.dtype,
+                    pallas_1x1=self.pallas_1x1,
                 )(x, train=train)
         x = x.mean(axis=(1, 2))  # global average pool
         x = nn.Dense(
@@ -107,8 +156,13 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
-    return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3), dtype=dtype)
+def ResNet50(
+    num_classes: int = 1000, dtype: Any = jnp.float32, pallas_1x1: bool = False
+) -> ResNet:
+    return ResNet(
+        num_classes=num_classes, stage_sizes=(3, 4, 6, 3), dtype=dtype,
+        pallas_1x1=pallas_1x1,
+    )
 
 
 def ResNet18Slim(num_classes: int = 10, dtype: Any = jnp.float32) -> ResNet:

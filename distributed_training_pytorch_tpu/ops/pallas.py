@@ -511,3 +511,152 @@ def _causal_plain(q, k, v):
     logits = jnp.where((idx[:, None] >= idx[None, :])[None, None], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+# ---------------------------------------------------------------------------
+# Fused 1x1-conv + BN-apply + ReLU (r4 VERDICT item 2: testing the ResNet
+# "not reachable from user-level JAX" claim with the one tractable kernel).
+#
+# A 1x1 conv IS a GEMM: NHWC input flattened to [N, Cin] against [Cin, Cout],
+# with the BatchNorm apply folded to a per-output-channel affine
+# (a = gamma * rsqrt(var + eps), b = beta - mean * a) and the ReLU as the
+# epilogue — one HBM read of x, one write of the activated output, nothing
+# materialized in between. ResNet stage-1's 56x56x(64<->256) branches run
+# ~28 FLOP/byte on a 240 FLOP/byte v5e — pure bandwidth — so the question is
+# only whether a hand-tiled GEMM+epilogue moves more bytes/s than XLA's
+# conv+fusion at these shapes (scripts/resnet_pallas_probe.py measures both;
+# BASELINE.md records the verdict).
+
+
+def _conv1x1_kernel(x_ref, w_ref, a_ref, b_ref, o_ref, *, relu):
+    acc = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    y = acc * a_ref[:] + b_ref[:]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def conv1x1_bn_act(
+    x: jax.Array,
+    w: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    relu: bool = True,
+    block_rows: int = 1024,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """``relu((x @ w) * scale + bias)`` fused in one Pallas pass.
+
+    ``x``: ``[..., Cin]`` (e.g. NHWC — leading dims flatten to rows);
+    ``w``: ``[Cin, Cout]`` (a 1x1 conv kernel squeezed); ``scale``/``bias``:
+    ``[Cout]`` — the folded BN apply (identity: ones/zeros). Grid over row
+    blocks; Cin/Cout stay whole (<= a few hundred channels at ResNet shapes,
+    so the weight slab and one x tile sit comfortably in VMEM). Matmul on
+    the MXU in f32 accumulation; epilogue on the VPU; output cast to
+    ``out_dtype`` (default: x.dtype)."""
+    lead = x.shape[:-1]
+    cin = x.shape[-1]
+    if w.shape[0] != cin:
+        raise ValueError(f"w {w.shape} does not match x Cin {cin}")
+    cout = w.shape[1]
+    n = 1
+    for d in lead:
+        n *= d
+    out_dtype = out_dtype or x.dtype
+    x2 = x.reshape(n, cin)
+    n_pad = -(-n // block_rows) * block_rows
+    if n_pad != n:
+        x2 = jnp.pad(x2, ((0, n_pad - n), (0, 0)))
+    a2 = scale.reshape(1, cout).astype(jnp.float32)
+    b2 = bias.reshape(1, cout).astype(jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_conv1x1_kernel, relu=relu),
+        grid=(n_pad // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, cin), lambda i: (i, 0)),
+            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, cout), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, cout), out_dtype),
+        interpret=interpret,
+    )(x2, w, a2, b2)
+    return out[:n].reshape(*lead, cout)
+
+
+def _conv1x1_fwd(x, w, scale, bias, relu, block_rows, out_dtype, interpret, affine_grads):
+    y = conv1x1_bn_act(
+        x, w, scale, bias, relu=relu, block_rows=block_rows,
+        out_dtype=out_dtype, interpret=interpret,
+    )
+    return y, (x, w, scale, bias, y)
+
+
+def _conv1x1_bwd(relu, block_rows, out_dtype, interpret, affine_grads, res, g):
+    """Standard GEMM backward in XLA dots (same shapes, MXU-friendly):
+    dz = g * 1{y>0} * scale; dx = dz @ w^T; dw = x^T @ dz. With
+    ``affine_grads``, dscale needs the pre-epilogue z — RECOMPUTED as x @ w
+    (inverting the epilogue from y divides by scale, which breaks on the
+    zero-init-gamma BN folds this kernel exists to serve)."""
+    x, w, scale, bias, y = res
+    lead = x.shape[:-1]
+    cin, cout = w.shape
+    g2 = g.reshape(-1, cout).astype(jnp.float32)
+    y2 = y.reshape(-1, cout).astype(jnp.float32)
+    x2 = x.reshape(-1, cin)
+    live = (y2 > 0) if relu else jnp.ones_like(y2, jnp.bool_)
+    gz = jnp.where(live, g2, 0.0)
+    if affine_grads:
+        dbias = jnp.sum(gz, axis=0)
+        z = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        dscale = jnp.sum(gz * z, axis=0)
+    else:
+        # Epilogue declared non-trainable (identity constants): skip the z
+        # recompute GEMM entirely.
+        dbias = jnp.zeros_like(bias)
+        dscale = jnp.zeros_like(scale)
+    dz = gz * scale  # [N, cout] f32
+    dx = (dz.astype(x.dtype) @ w.T.astype(x.dtype)).reshape(*lead, cin)
+    dw = jnp.dot(
+        x2.T, dz.astype(x.dtype), preferred_element_type=jnp.float32
+    ).astype(w.dtype)
+    return dx.astype(x.dtype), dw, dscale.astype(scale.dtype), dbias.astype(bias.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _conv1x1_diff(x, w, scale, bias, relu, block_rows, out_dtype, interpret, affine_grads):
+    return conv1x1_bn_act(
+        x, w, scale, bias, relu=relu, block_rows=block_rows,
+        out_dtype=out_dtype, interpret=interpret,
+    )
+
+
+_conv1x1_diff.defvjp(_conv1x1_fwd, _conv1x1_bwd)
+
+
+def conv1x1_bn_act_diff(
+    x: jax.Array,
+    w: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    relu: bool = True,
+    block_rows: int = 1024,
+    out_dtype=None,
+    interpret: bool = False,
+    affine_grads: bool = True,
+) -> jax.Array:
+    """Differentiable :func:`conv1x1_bn_act`: Pallas forward, standard-GEMM
+    XLA backward (custom VJP above). The primal output is the only residual
+    beyond the inputs — nothing autodiff would not already keep.
+
+    ``affine_grads=False`` declares scale/bias non-trainable constants (the
+    ``PallasConv1x1`` identity-epilogue use) and returns zero gradients for
+    them, skipping the backward's z-recompute GEMM."""
+    return _conv1x1_diff(
+        x, w, scale, bias, relu, block_rows, out_dtype or x.dtype, interpret,
+        affine_grads,
+    )

@@ -30,5 +30,7 @@ from distributed_training_pytorch_tpu.parallel.moe import (  # noqa: F401
     EXPERT_AXIS,
     MoEMlp,
     load_balance_loss,
+    manual_expert_ffn_local,
+    manual_expert_mlp,
     router_z_loss,
 )

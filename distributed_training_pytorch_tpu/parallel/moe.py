@@ -54,6 +54,8 @@ __all__ = [
     "MoEMlp",
     "SORT_DISPATCH_MIN_GROUP",
     "load_balance_loss",
+    "manual_expert_ffn_local",
+    "manual_expert_mlp",
     "router_z_loss",
 ]
 
@@ -104,6 +106,42 @@ def load_balance_loss(gates: jax.Array, dispatch_mask: jax.Array) -> jax.Array:
     f = jnp.mean(dispatch_mask.astype(jnp.float32), axis=0)  # [E]
     p = jnp.mean(gates.astype(jnp.float32), axis=0)  # [E]
     return num_experts * jnp.sum(f * p)
+
+
+def _route_group(group_gates, *, num_experts, capacity, top_k):
+    """GShard order-based-capacity top-k routing for ONE group:
+    ``[sg, E]`` gates -> ``(dispatch, combine, first_choice)`` with
+    dispatch/combine ``[sg, E, C]``. Choices claim capacity in priority
+    order (choice 0 of every token before any choice 1), so dropping is
+    deterministic; kept gates renormalize to sum 1 per token."""
+    e, sg = num_experts, group_gates.shape[0]
+    remaining = group_gates
+    dispatch = jnp.zeros((sg, e, capacity), jnp.bool_)
+    combine = jnp.zeros((sg, e, capacity), jnp.float32)
+    used = jnp.zeros((e,), jnp.int32)
+    gate_sum = jnp.zeros((sg,), jnp.float32)
+    first_choice = None
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)  # [sg]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [sg, E]
+        if first_choice is None:
+            first_choice = onehot
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [sg, E]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1) + used[choice]
+        keep = pos < capacity
+        gate = jnp.sum(group_gates * onehot, axis=-1) * keep
+        slot = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32
+        )
+        contrib = onehot[:, :, None].astype(jnp.float32) * slot[:, None, :]
+        contrib = contrib * keep[:, None, None]
+        dispatch = jnp.logical_or(dispatch, contrib > 0)
+        combine = combine + gate[:, None, None] * contrib
+        gate_sum = gate_sum + gate
+        used = used + jnp.sum(onehot * keep[:, None], axis=0)
+        remaining = remaining * (1.0 - onehot)
+    combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    return dispatch, combine, first_choice
 
 
 class MoEMlp(nn.Module):
@@ -199,35 +237,11 @@ class MoEMlp(nn.Module):
         # the group before any choice 1 — GShard policy) so dropping is
         # deterministic. Routing is vmapped over groups: one-hot buffers stay
         # O((S/G)^2) per group and shard over `data` with the groups.
-        def route(group_gates):  # [sg, E] -> dispatch/combine [sg, E, C]
-            remaining = group_gates
-            dispatch = jnp.zeros((sg, e, capacity), jnp.bool_)
-            combine = jnp.zeros((sg, e, capacity), jnp.float32)
-            used = jnp.zeros((e,), jnp.int32)
-            gate_sum = jnp.zeros((sg,), jnp.float32)
-            first_choice = None
-            for _ in range(self.top_k):
-                choice = jnp.argmax(remaining, axis=-1)  # [sg]
-                onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [sg, E]
-                if first_choice is None:
-                    first_choice = onehot
-                pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [sg, E]
-                pos = jnp.sum(pos_in_expert * onehot, axis=-1) + used[choice]
-                keep = pos < capacity
-                gate = jnp.sum(group_gates * onehot, axis=-1) * keep
-                slot = jax.nn.one_hot(
-                    jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32
-                )
-                contrib = onehot[:, :, None].astype(jnp.float32) * slot[:, None, :]
-                contrib = contrib * keep[:, None, None]
-                dispatch = jnp.logical_or(dispatch, contrib > 0)
-                combine = combine + gate[:, None, None] * contrib
-                gate_sum = gate_sum + gate
-                used = used + jnp.sum(onehot * keep[:, None], axis=0)
-                remaining = remaining * (1.0 - onehot)
-            # Renormalize kept gates (weights sum to 1 over surviving choices).
-            combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
-            return dispatch, combine, first_choice
+        # (_route_group at module level — shared with manual_expert_mlp.)
+        def route(group_gates):
+            return _route_group(
+                group_gates, num_experts=e, capacity=capacity, top_k=self.top_k
+            )
 
         # Same routing semantics, scatter/gather instead of one-hot algebra:
         # rank each (choice, token) entry within its expert by a stable sort
@@ -306,3 +320,229 @@ class MoEMlp(nn.Module):
         else:
             out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), expert_out)
         return out.reshape(orig_shape).astype(self.dtype)
+
+
+def manual_expert_mlp(
+    params,
+    x: jax.Array,
+    *,
+    num_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    num_groups: int = 1,
+    mesh=None,
+    data_axis: str = DATA_AXIS,
+    expert_axis: str = EXPERT_AXIS,
+    exchange: str = "auto",
+    dtype: Any = jnp.float32,
+) -> jax.Array:
+    """MoE FFN forward with expert parallelism expressed MANUALLY — the
+    workaround for the data x expert x pipe composition (r4 VERDICT item 7).
+
+    :class:`MoEMlp` expresses expert parallelism as sharding constraints and
+    lets GSPMD insert the token<->expert all-to-all. Inside
+    ``pipeline_apply``'s partial-manual region that path trips an upstream
+    XLA SPMD-partitioner CHECK (``spmd_partitioner_util.cc``
+    ``AllReduceAlongShardingDims``, repro: ``scripts/repro_triple_check.py``
+    — a process-fatal CHECK, so it cannot live in pytest). This function
+    sidesteps the partitioner entirely: a nested ``shard_map`` manual over
+    ``(data, expert)`` whose body does the MoE exchange by hand, two
+    formulations (``exchange=``):
+
+    * ``"all_to_all"`` — the canonical GShard exchange: token groups shard
+      jointly over ``(data, expert)``; each shard routes its own groups
+      (:func:`_route_group`, the exact semantics of the einsum path), the
+      ``[G_local, E, C, d]`` dispatch buffers swap experts<->groups with one
+      ``jax.lax.all_to_all`` over ``expert``, the local slab runs its FFN,
+      a second all_to_all returns the outputs, combine is local. Comm per
+      device: 2 x buffer/n_exp. NOT usable inside an enclosing manual region
+      whose free axis (``pipe``) sits between ``data`` and ``expert`` in the
+      mesh order — Shardy rejects the joint dim sharding ("manual axis
+      'expert' after free axis 'pipe'").
+    * ``"psum"`` — groups shard over ``data`` only; routing replicates over
+      the expert members, each applies its LOCAL expert slice of dispatch/
+      combine, and one ``psum`` over ``expert`` sums the partial outputs
+      (the :func:`manual_expert_ffn_local` formulation, runnable here
+      un-nested for parity testing). Comm per device: one [tokens, d]
+      all-reduce; prefer all_to_all.
+    * ``"auto"`` (default) — all_to_all.
+
+    NESTING: this function cannot run inside an enclosing ``shard_map``
+    (pipeline_apply) at all — Shardy rejects both re-binding a parent's
+    manual axis and an inner mesh differing from the context mesh — and
+    raises a ValueError pointing at the supported composition:
+    ``pipeline_apply(extra_manual_axes=("expert",), stage_param_specs=...)``
+    with :func:`manual_expert_ffn_local` stage bodies.
+
+    ``params``: an :class:`MoEMlp` ``variables["params"]`` tree (``router``
+    Dense kernel/bias, ``w_in``, ``w_out``) — training checkpoints swap
+    between the two implementations unchanged. ``x``: ``[..., d]``; token
+    count must divide by ``num_groups``; ``num_groups`` by
+    ``data_size * expert_size`` (all_to_all) or ``data_size`` (psum);
+    ``num_experts`` by ``expert_size``. Differentiable; aux losses are not
+    sow'd on this path (compute them from a separate router call if needed).
+    """
+    from jax import shard_map
+
+    # Inside a traced context the shard_map must receive the ambient ABSTRACT
+    # mesh (it carries e.g. pipe's Manual axis type from an enclosing
+    # pipeline_apply region); the concrete mesh arg is the fallback for
+    # un-nested use outside set_mesh.
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and getattr(ctx, "axis_names", ()):
+        mesh = ctx
+    elif mesh is None:
+        raise ValueError("manual_expert_mlp needs a mesh (arg or ambient set_mesh)")
+    axis_names = getattr(mesh, "axis_names", ())
+    n_exp = mesh.shape[expert_axis] if expert_axis in axis_names else 1
+    n_data = mesh.shape[data_axis] if data_axis in axis_names else 1
+    if exchange == "auto":
+        exchange = "all_to_all"
+    if exchange not in ("all_to_all", "psum"):
+        raise ValueError(f"exchange must be all_to_all|psum|auto, got {exchange!r}")
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    tokens = x.reshape(-1, d)
+    s = tokens.shape[0]
+    g = num_groups
+    e = num_experts
+    if s % g:
+        raise ValueError(f"{s} tokens not divisible by num_groups={g}")
+    need = n_data * n_exp if exchange == "all_to_all" else n_data
+    if g % need:
+        raise ValueError(f"num_groups={g} must divide by {need} shards ({exchange})")
+    if e % n_exp:
+        raise ValueError(f"num_experts={e} not divisible by expert axis {n_exp}")
+    sg = s // g
+    capacity = max(1, int(np.ceil(sg * top_k / e * capacity_factor)))
+
+    rk = params["router"]["kernel"]
+    rb = params["router"]["bias"]
+    w_in = params["w_in"]
+    w_out = params["w_out"]
+    grouped = tokens.reshape(g, sg, d)
+
+    def body_a2a(grouped_local, rk, rb, w_in_local, w_out_local):
+        # grouped_local: [G_local, sg, d]; w slabs: [E_local, d, h]/[E_local, h, d]
+        dispatch, combine, _ = _route_grouped(
+            grouped_local, rk, rb, num_experts=e, capacity=capacity, top_k=top_k
+        )
+        expert_in = jnp.einsum(
+            "gsec,gsd->gecd", dispatch.astype(dtype), grouped_local.astype(dtype)
+        )  # [G_local, E, C, d]
+        if n_exp > 1:
+            # experts -> groups exchange: split E into n_exp slabs, concat on
+            # the group dim — each expert shard now holds ITS experts'
+            # buffers for every group-set in this data row.
+            expert_in = jax.lax.all_to_all(
+                expert_in, expert_axis, split_axis=1, concat_axis=0, tiled=True
+            )  # [G_local*n_exp, E_local, C, d]
+        h = jax.nn.gelu(
+            jnp.einsum("gecd,edh->gech", expert_in, w_in_local.astype(dtype))
+        )
+        expert_out = jnp.einsum("gech,ehd->gecd", h, w_out_local.astype(dtype))
+        if n_exp > 1:
+            expert_out = jax.lax.all_to_all(
+                expert_out, expert_axis, split_axis=0, concat_axis=1, tiled=True
+            )  # [G_local, E, C, d]
+        out = jnp.einsum("gsec,gecd->gsd", combine.astype(dtype), expert_out)
+        return out
+
+    def body_psum(grouped_local, rk, rb, w_in_local, w_out_local):
+        params_local = {
+            "router": {"kernel": rk, "bias": rb},
+            "w_in": w_in_local,
+            "w_out": w_out_local,
+        }
+        return manual_expert_ffn_local(
+            params_local, grouped_local,
+            num_experts=e, n_expert_shards=n_exp, expert_axis=expert_axis,
+            top_k=top_k, capacity=capacity, dtype=dtype,
+        )
+
+    if getattr(mesh, "manual_axes", ()):
+        raise ValueError(
+            "manual_expert_mlp cannot nest inside an enclosing shard_map "
+            "(Shardy rejects both re-binding a parent's manual axis and a "
+            "sub-mesh that differs from the context mesh). Inside "
+            "pipeline_apply, pass extra_manual_axes=('expert',) + "
+            "stage_param_specs and call moe.manual_expert_ffn_local from the "
+            "stage body instead."
+        )
+    if exchange == "all_to_all":
+        body, x_spec = body_a2a, P((data_axis, expert_axis))
+    else:
+        body, x_spec = body_psum, P(data_axis)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(x_spec, P(), P(), P(expert_axis), P(expert_axis)),
+        out_specs=x_spec,
+        axis_names=frozenset(a for a in (data_axis, expert_axis) if a in axis_names),
+    )
+    out = fn(grouped, rk, rb, w_in, w_out)
+    return out.reshape(orig_shape).astype(dtype)
+
+
+def _route_grouped(grouped, rk, rb, *, num_experts, capacity, top_k):
+    """Router + per-group GShard routing over ``[G, sg, d]`` tokens."""
+    logits = grouped.astype(jnp.float32) @ rk + rb  # [G, sg, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    return jax.vmap(
+        lambda gg: _route_group(
+            gg, num_experts=num_experts, capacity=capacity, top_k=top_k
+        )
+    )(gates)
+
+
+def manual_expert_ffn_local(
+    params_local,
+    grouped: jax.Array,
+    *,
+    num_experts: int,
+    n_expert_shards: int,
+    expert_axis: str = EXPERT_AXIS,
+    top_k: int = 2,
+    capacity: int | None = None,
+    capacity_factor: float = 1.25,
+    dtype: Any = jnp.float32,
+) -> jax.Array:
+    """Expert-parallel MoE FFN for use INSIDE an already-manual region over
+    ``expert_axis`` — the stage-body half of the data x expert x pipe
+    workaround (``pipeline_apply(extra_manual_axes=("expert",), ...)``).
+
+    ``params_local``: MoEMlp-layout params whose ``w_in``/``w_out`` are this
+    shard's LOCAL ``[E/n, d, h]`` slabs (the region's in_specs sliced them);
+    router kernel/bias replicated. ``grouped``: ``[G, sg, d]`` tokens,
+    replicated over ``expert_axis``. Routing replicates across expert
+    members (:func:`_route_group` semantics — identical to the einsum path);
+    each member applies its local expert slice of dispatch/combine and one
+    ``psum`` over ``expert_axis`` sums the partial outputs."""
+    e = num_experts
+    n_exp = n_expert_shards
+    if capacity is None:
+        sg = grouped.shape[1]
+        capacity = max(1, int(np.ceil(sg * top_k / e * capacity_factor)))
+    rk = params_local["router"]["kernel"]
+    rb = params_local["router"]["bias"]
+    dispatch, combine, _ = _route_grouped(
+        grouped, rk, rb, num_experts=e, capacity=capacity, top_k=top_k
+    )
+    e_loc = e // n_exp
+    start = (
+        jax.lax.axis_index(expert_axis) * e_loc if n_exp > 1 else jnp.zeros((), jnp.int32)
+    )
+    disp_l = jax.lax.dynamic_slice_in_dim(dispatch.astype(dtype), start, e_loc, 2)
+    comb_l = jax.lax.dynamic_slice_in_dim(combine.astype(dtype), start, e_loc, 2)
+    expert_in = jnp.einsum("gsec,gsd->gecd", disp_l, grouped.astype(dtype))
+    h = jax.nn.gelu(
+        jnp.einsum("gecd,edh->gech", expert_in, params_local["w_in"].astype(dtype))
+    )
+    expert_out = jnp.einsum(
+        "gech,ehd->gecd", h, params_local["w_out"].astype(dtype)
+    )
+    out = jnp.einsum("gsec,gecd->gsd", comb_l, expert_out)
+    if n_exp > 1:
+        out = jax.lax.psum(out, expert_axis)
+    return out

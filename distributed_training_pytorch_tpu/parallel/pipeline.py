@@ -128,6 +128,8 @@ def pipeline_apply(
     first: tuple[Any, Callable] | None = None,
     last: tuple[Any, Callable] | None = None,
     remat: bool = False,
+    extra_manual_axes: tuple[str, ...] = (),
+    stage_param_specs: Any | None = None,
 ) -> jax.Array:
     """Run ``microbatches`` through the pipelined (virtual-)stage stack.
 
@@ -161,6 +163,20 @@ def pipeline_apply(
       remat: wrap each stage application in ``jax.checkpoint`` — backward
         recomputes stage activations instead of stashing every tick's
         residuals (activation-memory lever; schedule unchanged).
+      extra_manual_axes: additional mesh axes made MANUAL inside the ring
+        region (e.g. ``("expert",)``). Nested ``shard_map`` is rejected by
+        Shardy ("axis already bound by a parent manual_computation"), so a
+        stage body that needs hand-written collectives over another axis —
+        the ``moe.manual_expert_ffn_local`` workaround for the
+        data x expert x pipe GSPMD CHECK — declares that axis here and uses
+        ``jax.lax.psum``/``all_to_all`` over it directly. Activations are
+        treated as replicated over these axes; stage params shard per
+        ``stage_param_specs``.
+      stage_param_specs: pytree matching ONE stage's params whose leaves are
+        ``PartitionSpec``s over the non-stage dims (e.g. ``P("expert")`` for
+        a ``[E, d, h]`` expert slab, ``P()`` for replicated leaves). Required
+        exactly when ``extra_manual_axes`` shards any stage param; the stage
+        fn then receives LOCAL slabs.
 
     Returns ``[n_micro, micro_batch, ...]`` outputs of the last virtual
     stage (after ``last`` if given), replicated over ``axis``.
@@ -311,7 +327,12 @@ def pipeline_apply(
         return outputs
 
     sharded_head = last is not None and M % S == 0
-    chunk_specs = jax.tree.map(lambda _: P(None, axis), chunked)
+    if stage_param_specs is not None:
+        chunk_specs = jax.tree.map(
+            lambda spec: P(None, axis, *spec), stage_param_specs
+        )
+    else:
+        chunk_specs = jax.tree.map(lambda _: P(None, axis), chunked)
     fn = shard_map(
         body,
         mesh=mesh,
@@ -319,13 +340,14 @@ def pipeline_apply(
         # Plain path: the closing psum establishes replication. Sharded-head
         # path: outputs stay sharded over `axis` on dim 1, reassembled below.
         out_specs=P(None, axis) if sharded_head else P(),
-        # Manual over the pipe axis ONLY: every other mesh axis stays
-        # automatic, so stage bodies compose with the rest of the matrix —
-        # activations sharded over `data`, MoE weights over `expert`, TP over
-        # `model` — with GSPMD inserting those collectives inside each tick
-        # while the ring ppermute stays hand-scheduled. On a pipe-only mesh
-        # this is identical to full manual.
-        axis_names=frozenset({axis}),
+        # Manual over the pipe axis ONLY (plus any extra_manual_axes a stage
+        # body needs hand-written collectives over): every other mesh axis
+        # stays automatic, so stage bodies compose with the rest of the
+        # matrix — activations sharded over `data`, MoE weights over
+        # `expert`, TP over `model` — with GSPMD inserting those collectives
+        # inside each tick while the ring ppermute stays hand-scheduled. On a
+        # pipe-only mesh this is identical to full manual.
+        axis_names=frozenset({axis, *extra_manual_axes}),
     )
     out = fn(chunked, micro_in, first_params, last_params)
     if sharded_head:

@@ -245,10 +245,13 @@ BENCH_MODELS = {
         "metric": "images/sec/chip (ViT-B/16, ImageNet-shape, bf16)",
     },
     "resnet50": {
-        # BENCH_PALLAS_1X1=1: every 1x1 conv runs the Pallas GEMM kernel
-        # (models.resnet.PallasConv1x1) instead of XLA's conv — the r5 probe
-        # measured the kernel at 72% vs XLA's 45% of the HBM bandwidth floor
-        # on the stage-1 shapes (BASELINE.md "ResNet-50" r5 section).
+        # BENCH_PALLAS_1X1=1: the bandwidth-bound STAGE-1 1x1 convs (56x56
+        # maps — BottleneckBlock gates on input spatial >= 56) run the Pallas
+        # GEMM kernel (models.resnet.PallasConv1x1) instead of XLA's conv.
+        # r5 probe: kernel 72% vs XLA 45% of the HBM bandwidth floor in
+        # isolation, but the full step measures SLOWER (fusion-barrier cost;
+        # BASELINE.md "ResNet-50" r5 section) — the flag exists to reproduce
+        # that measurement, not as a perf default.
         "build": lambda n, size: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ResNet50"]
         ).ResNet50(

@@ -96,6 +96,14 @@ class CheckpointManager:
         self.wait()  # a name may be overwritten; finish any in-flight save first
         self._gc_periodic()  # previous save is committed; safe to prune now
         meta = {"epoch": int(epoch), "best_value": self._best_value}
+        # Record the param tree's top level so consumers can auto-select the
+        # restore target's wrapper layout (e.g. whether params nest under
+        # InputNormalizer's 'inner' scope — ADVICE r4: keying that on a
+        # mutable env var across train/resume/eval was a foot-gun).
+        try:
+            meta["params_top_level"] = sorted(state.params.keys())
+        except AttributeError:
+            pass
         if metrics is not None:
             meta["metrics"] = {k: float(v) for k, v in metrics.items()}
         # Decomposed layout (params / opt_state / rest) — the analog of the
@@ -156,15 +164,7 @@ class CheckpointManager:
         fine-tuning) whose optimizer differs from the training run's.
         """
         self.wait()  # an in-flight async save only becomes visible once committed
-        path = self.path(name_or_path) if os.sep not in name_or_path else name_or_path
-        path = os.path.abspath(path)  # orbax rejects relative paths
-        if not os.path.isdir(path):
-            raise FileNotFoundError(f"no checkpoint at {path}")
-        if os.path.isdir(os.path.join(path, "state")):
-            raise ValueError(
-                f"{path} uses the pre-0.1 monolithic 'state' checkpoint layout; "
-                "re-save it with this version (decomposed params/opt_state/rest)."
-            )
+        path = self._resolve(name_or_path)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target_state)
         items = {
             "params": ocp.args.StandardRestore(abstract.params),
@@ -200,6 +200,31 @@ class CheckpointManager:
                 rng=restored.rest["rng"],
             )
         return state, int(meta.get("epoch", 0))
+
+    def _resolve(self, name_or_path: str) -> str:
+        """Name-or-path -> absolute checkpoint dir, with the existence and
+        pre-0.1-layout checks every reader needs."""
+        path = self.path(name_or_path) if os.sep not in name_or_path else name_or_path
+        path = os.path.abspath(path)  # orbax rejects relative paths
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        if os.path.isdir(os.path.join(path, "state")):
+            raise ValueError(
+                f"{path} uses the pre-0.1 monolithic 'state' checkpoint layout; "
+                "re-save it with this version (decomposed params/opt_state/rest)."
+            )
+        return path
+
+    def read_meta(self, name_or_path: str) -> dict:
+        """The checkpoint's meta json alone (epoch, best_value, metrics,
+        params_top_level) — no state structure needed, so consumers can
+        inspect a checkpoint's layout BEFORE building the restore target."""
+        self.wait()
+        restored = self._ckptr.restore(
+            self._resolve(name_or_path),
+            args=ocp.args.Composite(meta=ocp.args.JsonRestore()),
+        )
+        return dict(restored.meta or {})
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -113,8 +113,11 @@ class ResNet(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     width: int = 64
     dtype: Any = jnp.float32
-    # Route every 1x1 conv through the Pallas GEMM (PallasConv1x1). Changes
-    # the param tree (module names), so flip only on fresh inits.
+    # Route the bandwidth-bound stage-1 1x1 convs (input spatial >= 56, see
+    # BottleneckBlock.conv1x1's gate) through the Pallas GEMM (PallasConv1x1).
+    # Changes the param tree (module names), so flip only on fresh inits.
+    # Measured slower end-to-end (fusion-barrier cost, BASELINE.md r5) — a
+    # measurement knob, not a perf default.
     pallas_1x1: bool = False
 
     @nn.compact

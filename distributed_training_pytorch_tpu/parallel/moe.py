@@ -470,14 +470,21 @@ def manual_expert_mlp(
             "stage_param_specs and call moe.manual_expert_ffn_local from the "
             "stage body instead."
         )
+    # Specs reference only axes the mesh actually has — degenerate meshes
+    # (no expert axis, or no data axis) run the same bodies with the
+    # collectives compiled out (`if n_exp > 1` guards).
+    def _present(*axes):
+        return P(tuple(a for a in axes if a in axis_names) or None)
+
+    w_spec = _present(expert_axis)
     if exchange == "all_to_all":
-        body, x_spec = body_a2a, P((data_axis, expert_axis))
+        body, x_spec = body_a2a, _present(data_axis, expert_axis)
     else:
-        body, x_spec = body_psum, P(data_axis)
+        body, x_spec = body_psum, _present(data_axis)
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(x_spec, P(), P(), P(expert_axis), P(expert_axis)),
+        in_specs=(x_spec, P(), P(), w_spec, w_spec),
         out_specs=x_spec,
         axis_names=frozenset(a for a in (data_axis, expert_axis) if a in axis_names),
     )

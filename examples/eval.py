@@ -111,17 +111,33 @@ if __name__ == "__main__":
         model = create_model(
             os.environ["EVAL_MODEL"], num_classes=len(labels or LABELS)
         )
-        # Checkpoints from examples/train_imagenet.py default (SHIP_UINT8=1)
-        # nest params under InputNormalizer's 'inner' scope; the restore
-        # target must match. Same knob, same default, scoped to the models
-        # that trainer produces — so defaults trained == defaults evaluated;
-        # SHIP_UINT8=0 here for pre-r4 / unwrapped snapshots. (VGG16 runs
-        # from main.py are never wrapped and take the EVAL_MODEL-unset path.)
-        imagenet_family = os.environ["EVAL_MODEL"] in (
-            "resnet50", "vit_b16", "convnext_l", "convnext_tiny",
-            "resnet18_slim", "vit_tiny",
+        # Whether params nest under InputNormalizer's 'inner' scope (the
+        # SHIP_UINT8 trainer default) is read from the CHECKPOINT's own meta
+        # (manager.save records params_top_level — ADVICE r4: the restore
+        # target must match what was trained, not a mutable env var).
+        # Checkpoints predating the meta key fall back to the SHIP_UINT8
+        # knob + the trainer's model allowlist.
+        wrapped = None
+        mgr = CheckpointManager(
+            os.path.dirname(checkpoint_dir.rstrip("/")), async_save=False
         )
-        if imagenet_family and os.environ.get("SHIP_UINT8", "1") != "0":
+        try:
+            # KeyError: checkpoints without a 'meta' item (orbax raises it,
+            # not FileNotFoundError) fall back to the env heuristic too.
+            top = mgr.read_meta(checkpoint_dir).get("params_top_level")
+            if top is not None:
+                wrapped = top == ["inner"]
+        except (FileNotFoundError, ValueError, KeyError):
+            pass
+        finally:
+            mgr.close()
+        if wrapped is None:
+            imagenet_family = os.environ["EVAL_MODEL"] in (
+                "resnet50", "vit_b16", "convnext_l", "convnext_tiny",
+                "resnet18_slim", "vit_tiny",
+            )
+            wrapped = imagenet_family and os.environ.get("SHIP_UINT8", "1") != "0"
+        if wrapped:
             from distributed_training_pytorch_tpu.data import transforms as _T
             from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer
 

@@ -318,3 +318,21 @@ def test_sharded_restore_onto_different_topology(tmp_path, devices):
     restored1, _ = mgr.restore(LAST, target1)
     mgr.close()
     _leaves_equal(state, restored1)
+
+
+def test_meta_records_param_layout_and_reads_back(tmp_path, shared):
+    """save() records the param tree's top level; read_meta returns it
+    without a restore target — the wrapper-layout auto-select contract
+    (examples/eval.py builds InputNormalizer targets from it)."""
+    _, state, _ = shared
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    mgr.save(LAST, state, epoch=2)
+    meta = mgr.read_meta(LAST)
+    assert meta["epoch"] == 2
+    assert meta["params_top_level"] == sorted(state.params.keys())
+
+    # a wrapped-layout state (params nested under 'inner') records that
+    wrapped = state.replace(params={"inner": state.params})
+    mgr.save("wrapped", wrapped, epoch=3)
+    assert mgr.read_meta("wrapped")["params_top_level"] == ["inner"]
+    mgr.close()

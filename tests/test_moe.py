@@ -325,3 +325,24 @@ def test_manual_expert_mlp_rejects_nesting(devices):
                     axis_names=frozenset({mesh_lib.PIPE_AXIS}),
                 )
             )(x)
+
+
+def test_manual_expert_mlp_degenerate_mesh(devices):
+    """On a mesh without an expert axis the specs reference only present
+    axes and the collectives compile out — exact parity with plain apply."""
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+    from distributed_training_pytorch_tpu.parallel.moe import manual_expert_mlp
+
+    rng = np.random.RandomState(3)
+    moe = MoEMlp(num_experts=2, hidden_dim=8, top_k=1, num_groups=2,
+                 dispatch_impl="einsum")
+    x = jnp.asarray(rng.randn(2, 4, 8), jnp.float32)
+    v = moe.init(jax.random.key(0), x)
+    mesh = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 2}, devices=devices[:2])
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(
+            lambda p, x: manual_expert_mlp(
+                p, x, num_experts=2, top_k=1, num_groups=2, mesh=mesh
+            )
+        )(v["params"], x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(moe.apply(v, x)), atol=1e-6)

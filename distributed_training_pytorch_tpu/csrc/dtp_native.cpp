@@ -15,6 +15,7 @@
 // across hosts and resumes regardless of thread scheduling.
 
 #include <algorithm>
+#include <cmath>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -100,6 +101,38 @@ static void bilinear_resize_u8(const uint8_t* src, int sh, int sw,
       const uint8_t* p01 = src + ((size_t)y0 * sw + x1) * 3;
       const uint8_t* p10 = src + ((size_t)y1 * sw + x0) * 3;
       const uint8_t* p11 = src + ((size_t)y1 * sw + x1) * 3;
+      uint8_t* d = dst + ((size_t)y * dw + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        double v = p00[c] * (1 - wy) * (1 - wx) + p01[c] * (1 - wy) * wx +
+                   p10[c] * wy * (1 - wx) + p11[c] * wy * wx;
+        d[c] = (uint8_t)(v + 0.5);
+      }
+    }
+  }
+}
+
+// Bilinear resize sampling a WINDOW (x0, y0, cw, ch) of the source — the
+// crop+resize core of random-resized-crop; optional horizontal mirror of the
+// destination. Same half-pixel-center convention as bilinear_resize_u8.
+static void bilinear_resize_window_u8(const uint8_t* src, int sh, int sw,
+                                      int x0, int y0, int cw, int ch,
+                                      uint8_t* dst, int dh, int dw, bool mirror) {
+  const double sy = (double)ch / dh, sx = (double)cw / dw;
+  for (int y = 0; y < dh; ++y) {
+    double fy = (y + 0.5) * sy - 0.5;
+    int iy0 = (int)fy; double wy = fy - iy0;
+    if (fy < 0) { iy0 = 0; wy = 0.0; }
+    int iy1 = iy0 + 1 < ch ? iy0 + 1 : ch - 1;
+    for (int x = 0; x < dw; ++x) {
+      int gx = mirror ? (dw - 1 - x) : x;
+      double fx = (gx + 0.5) * sx - 0.5;
+      int ix0 = (int)fx; double wx = fx - ix0;
+      if (fx < 0) { ix0 = 0; wx = 0.0; }
+      int ix1 = ix0 + 1 < cw ? ix0 + 1 : cw - 1;
+      const uint8_t* p00 = src + ((size_t)(y0 + iy0) * sw + x0 + ix0) * 3;
+      const uint8_t* p01 = src + ((size_t)(y0 + iy0) * sw + x0 + ix1) * 3;
+      const uint8_t* p10 = src + ((size_t)(y0 + iy1) * sw + x0 + ix0) * 3;
+      const uint8_t* p11 = src + ((size_t)(y0 + iy1) * sw + x0 + ix1) * 3;
       uint8_t* d = dst + ((size_t)y * dw + x) * 3;
       for (int c = 0; c < 3; ++c) {
         double v = p00[c] * (1 - wy) * (1 - wx) + p01[c] * (1 - wy) * wx +
@@ -368,6 +401,79 @@ int64_t dtp_decode_resize_u8_bytes(const uint8_t* const* bufs,
   std::atomic<int64_t> failed(-1);
   DecodeU8Args a{bufs, lengths, out_h, out_w, out, &failed};
   run_parallel(n, threads, decode_u8_one, &a);
+  return failed.load() >= 0 ? failed.load() + 1 : 0;
+}
+
+// Decode + RANDOM-RESIZED-CROP + optional hflip, uint8 out — the ImageNet
+// train augmentation: 10 attempts sampling an area fraction in
+// [scale_lo, scale_hi] and a log-uniform aspect ratio in [ratio_lo,
+// ratio_hi], center-SQUARE fallback — matching this repo's
+// transforms.random_resized_crop (torchvision instead clamps the fallback
+// crop to the ratio bounds; the distributions differ only on extreme-aspect
+// images that exhaust all 10 attempts). Fused with the decode so the
+// full-size image never leaves this call. Philox keyed (seed,
+// epoch<<40 | index[i]) like every other augmenter here.
+struct DecodeRrcArgs {
+  const uint8_t* const* bufs;
+  const int64_t* lengths;
+  int out_h, out_w;
+  uint64_t seed, epoch;
+  const int64_t* indices;
+  int hflip;
+  float scale_lo, scale_hi, ratio_lo, ratio_hi;
+  uint8_t* out;
+  std::atomic<int64_t>* failed;
+};
+
+static void decode_rrc_one(int64_t i, void* p) {
+  DecodeRrcArgs* a = (DecodeRrcArgs*)p;
+  int h = 0, w = 0;
+  uint8_t* img = decode_bytes(a->bufs[i], (size_t)a->lengths[i], &h, &w);
+  if (!img) {
+    int64_t expect = -1;
+    a->failed->compare_exchange_strong(expect, i);
+    return;
+  }
+  Philox rng;
+  rng.init(a->seed, (a->epoch << 40) | (uint64_t)a->indices[i]);
+  const double area = (double)h * w;
+  const double log_rlo = std::log((double)a->ratio_lo);
+  const double log_rhi = std::log((double)a->ratio_hi);
+  int x0 = 0, y0 = 0, cw = w, ch = h;
+  bool found = false;
+  for (int att = 0; att < 10 && !found; ++att) {
+    double target = area * (a->scale_lo + rng.uniform() * (a->scale_hi - a->scale_lo));
+    double r = std::exp(log_rlo + rng.uniform() * (log_rhi - log_rlo));
+    int tw = (int)std::lround(std::sqrt(target * r));
+    int th = (int)std::lround(std::sqrt(target / r));
+    if (tw > 0 && tw <= w && th > 0 && th <= h) {
+      y0 = (int)rng.randint((uint32_t)(h - th + 1));
+      x0 = (int)rng.randint((uint32_t)(w - tw + 1));
+      cw = tw; ch = th;
+      found = true;
+    }
+  }
+  if (!found) {  // center-square fallback (transforms.random_resized_crop)
+    int side = h < w ? h : w;
+    y0 = (h - side) / 2; x0 = (w - side) / 2;
+    cw = side; ch = side;
+  }
+  bool flip = a->hflip && rng.uniform() < 0.5;
+  bilinear_resize_window_u8(img, h, w, x0, y0, cw, ch,
+                            a->out + (size_t)i * a->out_h * a->out_w * 3,
+                            a->out_h, a->out_w, flip);
+  free(img);
+}
+
+int64_t dtp_decode_rrc_flip_u8_bytes(
+    const uint8_t* const* bufs, const int64_t* lengths, int64_t n, int out_h,
+    int out_w, uint64_t seed, uint64_t epoch, const int64_t* indices,
+    int hflip, float scale_lo, float scale_hi, float ratio_lo, float ratio_hi,
+    uint8_t* out, int threads) {
+  std::atomic<int64_t> failed(-1);
+  DecodeRrcArgs a{bufs, lengths, out_h, out_w, seed, epoch, indices, hflip,
+                  scale_lo, scale_hi, ratio_lo, ratio_hi, out, &failed};
+  run_parallel(n, threads, decode_rrc_one, &a);
   return failed.load() >= 0 ? failed.load() + 1 : 0;
 }
 

@@ -110,6 +110,12 @@ def _load():
         lib.dtp_decode_resize_u8_bytes.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), i64ptr, i64, i32, i32, u8ptr, i32,
         ]
+        f32 = ctypes.c_float
+        lib.dtp_decode_rrc_flip_u8_bytes.restype = i64
+        lib.dtp_decode_rrc_flip_u8_bytes.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), i64ptr, i64, i32, i32, u64, u64,
+            i64ptr, i32, f32, f32, f32, f32, u8ptr, i32,
+        ]
         _lib = lib
         return _lib
 
@@ -209,6 +215,44 @@ def decode_resize_u8_bytes(
     bufs = (ctypes.c_char_p * n)(*payloads)
     out = np.empty((n, height, width, 3), np.uint8)
     rc = lib.dtp_decode_resize_u8_bytes(bufs, lengths, n, height, width, out, _threads(threads))
+    if rc:
+        raise DecodeError(rc - 1)
+    return out
+
+
+def decode_rrc_flip_u8_bytes(
+    payloads: Sequence[bytes],
+    height: int,
+    width: int,
+    indices: np.ndarray,
+    *,
+    seed: int,
+    epoch: int,
+    hflip: bool = True,
+    scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3),
+    threads: int | None = None,
+) -> np.ndarray:
+    """In-memory JPEG/PNG payloads -> [N, H, W, 3] uint8 via decode +
+    RANDOM-RESIZED-CROP + optional hflip fused in one native call — the
+    ImageNet train augmentation (10-attempt sampling with the repo's
+    transforms.random_resized_crop center-square fallback; torchvision's
+    fallback ratio-clamps instead), Philox-keyed per (seed, epoch,
+    indices[i]). The
+    full-size decode never crosses back into Python."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(payloads)
+    lengths = np.asarray([len(p) for p in payloads], np.int64)
+    bufs = (ctypes.c_char_p * n)(*payloads)
+    out = np.empty((n, height, width, 3), np.uint8)
+    rc = lib.dtp_decode_rrc_flip_u8_bytes(
+        bufs, lengths, n, height, width, seed, epoch,
+        np.ascontiguousarray(indices, np.int64), int(hflip),
+        float(scale[0]), float(scale[1]), float(ratio[0]), float(ratio[1]),
+        out, _threads(threads),
+    )
     if rc:
         raise DecodeError(rc - 1)
     return out

@@ -152,6 +152,13 @@ class RecordFileSource:
         # a packed image folder) falls back to the Python path per record
         return payload[:2] == b"\xff\xd8" or payload[:8] == b"\x89PNG\r\n\x1a\n"
 
+    def _native_positions(self, payloads) -> list:
+        """Batch positions the native decoders can take (empty when the
+        native lib is off) — the mixed_native_batch split, one place."""
+        if getattr(self, "_native", None) is None:
+            return []
+        return [p for p, pl in enumerate(payloads) if self._native_decodable(pl)]
+
     def read_record(self, index: int) -> tuple[bytes, int]:
         # os.pread: positioned reads are atomic per call, so loader worker
         # THREADS can share one fd per shard — a seek()+read() pair on a
@@ -227,7 +234,7 @@ class NativeRecordFileSource(RecordFileSource):
                     len(rows),
                     self.height,
                     self.width,
-                    [p for p, pl in enumerate(payloads) if self._native_decodable(pl)],
+                    self._native_positions(payloads),
                     lambda pos: self._native.decode_resize_normalize_bytes(
                         [payloads[p] for p in pos], self.height, self.width, self.mean, self.std
                     ),
@@ -258,6 +265,16 @@ class NativeRecordTrainSource(RecordFileSource):
     draws — each path deterministic, not bit-identical) when the native
     library is unavailable.
 
+    Two augmentation modes (``aug=``):
+
+    * ``"pad_crop"`` — CIFAR-style reflect-pad random crop (+ flip) on the
+      resized image; decode and augment are two native batch calls.
+    * ``"rrc"`` — ImageNet-style RANDOM-RESIZED-CROP (+ flip), 10-attempt
+      sampling with ``transforms.random_resized_crop`` center-square
+      fallback, FUSED with the decode in one native call
+      (``dtp_decode_rrc_flip_u8_bytes``) so the full-size decode never
+      crosses back into Python.
+
     ``hflip=False`` for orientation-sensitive corpora (digits/text);
     ``train=False`` skips augmentation (uint8 val/eval ship)."""
 
@@ -267,6 +284,7 @@ class NativeRecordTrainSource(RecordFileSource):
         height: int,
         width: int,
         *,
+        aug: str = "pad_crop",
         pad: int = 4,
         seed: int = 0,
         hflip: bool = True,
@@ -274,8 +292,11 @@ class NativeRecordTrainSource(RecordFileSource):
     ):
         from distributed_training_pytorch_tpu.data import native
 
+        if aug not in ("pad_crop", "rrc"):
+            raise ValueError(f"aug must be pad_crop|rrc, got {aug!r}")
         super().__init__(pattern, transform=None)
         self.height, self.width = height, width
+        self.aug = aug
         self.pad = pad
         self.seed = seed
         self.hflip = hflip
@@ -296,16 +317,11 @@ class NativeRecordTrainSource(RecordFileSource):
                 interpolation=cv2.INTER_LINEAR,
             )
 
-        native_pos = (
-            [p for p, pl in enumerate(payloads) if self._native_decodable(pl)]
-            if self._native is not None
-            else []
-        )
         return mixed_native_batch(
             len(payloads),
             self.height,
             self.width,
-            native_pos,
+            self._native_positions(payloads),
             lambda pos: self._native.decode_resize_u8_bytes(
                 [payloads[p] for p in pos], self.height, self.width
             ),
@@ -337,10 +353,49 @@ class NativeRecordTrainSource(RecordFileSource):
             out[i] = img
         return out
 
+    def _rrc_py(self, payload: bytes, epoch: int, index: int) -> np.ndarray:
+        """Per-record Python RRC fallback: decode + transforms.random_resized_crop
+        (+ flip), keyed like the native path (independent Philox draws)."""
+        from distributed_training_pytorch_tpu.data import transforms as T
+
+        rng = np.random.Generator(
+            np.random.Philox(key=T.philox_key(self.seed, epoch, int(index)))
+        )
+        img = T.random_resized_crop(self.height, self.width)(self.decode(payload), rng)
+        if self.hflip and rng.random() < 0.5:
+            img = img[:, ::-1]
+        return np.ascontiguousarray(img)
+
+    def _load_batch_rrc(self, payloads, rows, epoch: int) -> np.ndarray:
+        from distributed_training_pytorch_tpu.data.native import (
+            decode_rrc_flip_u8_bytes,
+            mixed_native_batch,
+        )
+
+        idx = np.asarray(rows, np.int64)
+        return mixed_native_batch(
+            len(payloads),
+            self.height,
+            self.width,
+            self._native_positions(payloads),
+            lambda pos: decode_rrc_flip_u8_bytes(
+                [payloads[p] for p in pos], self.height, self.width, idx[pos],
+                seed=self.seed, epoch=epoch, hflip=self.hflip,
+            ),
+            lambda p: self._rrc_py(payloads[p], epoch, int(idx[p])),
+            dtype=np.uint8,
+        )
+
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         from distributed_training_pytorch_tpu.data.native import DecodeError
 
         payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
+        if self.train and self.aug == "rrc":
+            try:
+                images = self._load_batch_rrc(payloads, rows, epoch)
+            except DecodeError as e:
+                self._raise_located(e, rows)
+            return {"image": images, "label": np.asarray(labels, np.int32)}
         try:
             images = self._decode_u8(payloads)
         except DecodeError as e:

@@ -103,6 +103,11 @@ class _LimitedSource:
         self.source = source
         self.transform = getattr(source, "transform", None)
         self._len = min(len(source), max_records)
+        # Forward the loader's whole-batch fast path: hiding a source's
+        # load_batch would silently drop native decode+augment (the capped
+        # row indices are valid for the underlying source unchanged).
+        if hasattr(source, "load_batch"):
+            self.load_batch = source.load_batch
 
     def __len__(self):
         return self._len
@@ -127,7 +132,23 @@ class ImageNetTrainer(Trainer):
     def build_train_dataset(self):
         tfm = train_transform(self.image_size, seed=self.seed, ship_uint8=_ship_uint8())
         if self.train_records:
-            source = RecordFileSource(self.train_records, transform=tfm)
+            from distributed_training_pytorch_tpu.data import NativeRecordTrainSource, native
+
+            if (
+                _ship_uint8()
+                and native.available()
+                and os.environ.get("RECORDS_NATIVE", "1") != "0"
+            ):
+                # The full native batch path: decode + random-resized-crop +
+                # flip FUSED in one C++ call per batch, uint8 to the device
+                # (InputNormalizer). Falls through to the per-record Python
+                # pipeline when the native lib (or uint8 ship) is off.
+                source = NativeRecordTrainSource(
+                    self.train_records, self.image_size, self.image_size,
+                    aug="rrc", seed=self.seed,
+                )
+            else:
+                source = RecordFileSource(self.train_records, transform=tfm)
         else:
             self.log("IMAGENET_RECORDS unset — synthetic ImageNet-shaped data", "warning")
             source = synthetic_source(8192, self.image_size, self.num_classes, tfm, seed=0)

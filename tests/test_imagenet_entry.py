@@ -55,3 +55,89 @@ def test_end_to_end_resnet50_synthetic(tmp_path, monkeypatch):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     runpy.run_path(os.path.join(repo, "examples", "train_imagenet.py"), run_name="__main__")
     assert (tmp_path / "weights").exists()
+
+
+def test_build_train_dataset_records_native_rrc(tmp_path, monkeypatch):
+    """With IMAGENET_RECORDS set (+ uint8 ship + native lib), the trainer's
+    train dataset is the fused native decode+RRC source producing uint8
+    batches; RECORDS_NATIVE=0 falls back to the per-record Python path."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from distributed_training_pytorch_tpu.data import (
+        NativeRecordTrainSource,
+        RecordFileSource,
+        native,
+        write_shards,
+    )
+
+    rng = np.random.RandomState(0)
+    items = []
+    for i in range(8):
+        buf = io.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (40, 40, 3), np.uint8)).save(
+            buf, format="JPEG"
+        )
+        items.append((buf.getvalue(), i % 3))
+    write_shards(str(tmp_path / "train"), items, num_shards=1)
+    monkeypatch.setenv("IMAGENET_RECORDS", str(tmp_path))
+    monkeypatch.delenv("SHIP_UINT8", raising=False)
+    monkeypatch.delenv("RECORDS_NATIVE", raising=False)
+
+    mod = _load_module()
+    trainer = object.__new__(mod.ImageNetTrainer)  # dataset hook only
+    trainer.model_name = "resnet50"
+    trainer.image_size = 32
+    trainer.seed = 0
+    trainer.batch_size = 4
+    trainer.num_classes = 3
+    trainer.train_records = str(tmp_path)
+    trainer.log = lambda *a, **k: None
+    src = trainer.build_train_dataset()
+    if native.available():
+        assert isinstance(src, NativeRecordTrainSource) and src.aug == "rrc"
+        batch = src.load_batch(np.arange(4), epoch=0)
+        assert batch["image"].dtype == np.uint8
+        assert batch["image"].shape == (4, 32, 32, 3)
+    monkeypatch.setenv("RECORDS_NATIVE", "0")
+    src2 = trainer.build_train_dataset()
+    assert isinstance(src2, RecordFileSource)
+    assert not isinstance(src2, NativeRecordTrainSource)
+
+
+def test_limited_source_forwards_load_batch(tmp_path):
+    """STEPS_PER_EPOCH's _LimitedSource must not hide a source's whole-batch
+    native path — regression: hiding load_batch dropped decode+augment and
+    fed raw full-size records (r5 review finding)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from distributed_training_pytorch_tpu.data import (
+        NativeRecordTrainSource,
+        ShardedLoader,
+        write_shards,
+    )
+
+    rng = np.random.RandomState(1)
+    items = []
+    for i in range(8):
+        buf = io.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (40 + i, 50, 3), np.uint8)).save(
+            buf, format="PNG"
+        )
+        items.append((buf.getvalue(), i % 2))
+    write_shards(str(tmp_path / "t"), items, num_shards=1)
+    mod = _load_module()
+    src = NativeRecordTrainSource(str(tmp_path), 32, 32, aug="rrc", seed=0)
+    capped = mod._LimitedSource(src, 4)
+    loader = ShardedLoader(
+        capped, 4, shuffle=False, num_workers=0, process_index=0, process_count=1
+    )
+    batch = next(iter(loader))
+    # augmented uint8 at target size — NOT raw variable-size decodes
+    assert batch["image"].dtype == np.uint8
+    assert batch["image"].shape == (4, 32, 32, 3)

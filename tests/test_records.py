@@ -293,3 +293,58 @@ def test_mixed_batch_decode_error_names_batch_position(tmp_path):
     # shuffled rows: still the same record named, by its global identity
     with pytest.raises(ValueError, match=r"record 2 \("):
         src.load_batch(np.array([3, 2, 1, 0]), epoch=0)
+
+
+def test_native_train_source_rrc_mode(tmp_path):
+    """aug='rrc' (ImageNet random-resized-crop fused with decode): uint8 out,
+    deterministic per (seed, epoch, record), varies across epochs, and a
+    constant-color source stays constant (any crop+resize of a constant is
+    that constant) — content-level sanity for the crop window math."""
+    import io
+
+    from PIL import Image
+
+    from distributed_training_pytorch_tpu.data import NativeRecordTrainSource, native
+
+    rng = np.random.RandomState(21)
+    items = []
+    for i in range(8):
+        img = rng.randint(0, 255, (60, 80, 3), np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        items.append((buf.getvalue(), i % 3))
+    const = np.full((50, 70, 3), (10, 200, 90), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(const).save(buf, format="PNG")
+    items.append((buf.getvalue(), 0))
+    write_shards(str(tmp_path / "t"), items, num_shards=2)
+
+    for use_native in ([True, False] if native.available() else [False]):
+        src = NativeRecordTrainSource(str(tmp_path), 32, 32, aug="rrc", seed=5)
+        if not use_native:
+            src._native = None
+        b1 = src.load_batch(np.arange(9), epoch=0)
+        assert b1["image"].dtype == np.uint8 and b1["image"].shape == (9, 32, 32, 3)
+        b2 = src.load_batch(np.arange(9), epoch=0)
+        np.testing.assert_array_equal(b1["image"], b2["image"])
+        b3 = src.load_batch(np.arange(9), epoch=1)
+        assert not np.array_equal(b1["image"], b3["image"])
+        # round-robin sharding: the constant record (writer index 8) lands at
+        # global index 4 (shard 0 holds writer items 0,2,4,6,8)
+        const_row = b1["image"][4]
+        np.testing.assert_array_equal(
+            const_row, np.broadcast_to((10, 200, 90), (32, 32, 3)).astype(np.uint8)
+        )
+
+
+def test_rrc_mode_val_path_is_plain_resize(tmp_path):
+    """train=False in rrc mode ships the plain decode+resize (no random crop)."""
+    from distributed_training_pytorch_tpu.data import NativeRecordTrainSource
+
+    rng = np.random.RandomState(22)
+    items = [(_png_bytes(rng, 40, 40), 0) for _ in range(4)]
+    write_shards(str(tmp_path / "t"), items, num_shards=1)
+    src = NativeRecordTrainSource(str(tmp_path), 16, 16, aug="rrc", train=False)
+    a = src.load_batch(np.arange(4), epoch=0)
+    b = src.load_batch(np.arange(4), epoch=7)  # epoch must not matter
+    np.testing.assert_array_equal(a["image"], b["image"])

@@ -41,6 +41,7 @@ from distributed_training_pytorch_tpu.models import VGG16
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+from distributed_training_pytorch_tpu.utils import hlo_flops
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng, tpu_compiler_options
 
 # bf16 peak TFLOP/s per chip, by PJRT device_kind substring.
@@ -514,8 +515,8 @@ def main():
         compiled = engine.compile_chained_train_steps(
             state, gbatch, steps, compiler_options=opts
         )
-        cost = compiled.cost_analysis()
-        xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        cost = hlo_flops.xla_cost_analysis(compiled)
+        xla_step_flops = float(cost.get("flops", 0.0))
         # Guard (ADVICE r3): the per-step figure above relies on XLA counting
         # the scan body ONCE (verified on this version: chained == single-step
         # flops exactly). If a future XLA multiplies by trip count, the
@@ -538,8 +539,8 @@ def main():
         run_window = lambda st: compiled(st, gbatch)
     else:
         probe = engine.compile_train_step(state, gbatch, compiler_options=opts)
-        cost = probe.cost_analysis()
-        xla_step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        cost = hlo_flops.xla_cost_analysis(probe)
+        xla_step_flops = float(cost.get("flops", 0.0))
 
         def run_window(st):
             for _ in range(steps):

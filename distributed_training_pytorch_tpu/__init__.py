@@ -18,7 +18,14 @@ offline evaluator — rebuilt TPU-first:
   (replaces DDP + criterion/optimizer/scheduler mutation).
 * ``data``      — deterministic host-sharded input pipeline with device prefetch
   (replaces ``DistributedSampler`` + ``DataLoader``).
-* ``checkpoint``— Orbax-backed best/last/periodic checkpointing with resume.
+* ``checkpoint``— Orbax-backed best/last/periodic checkpointing with resume,
+  crash-consistent atomic commits, integrity validation, and newest-valid
+  fallback (docs/fault_tolerance.md).
+* ``fault``     — fault-injection harness (``FaultPlan``) + hung-step
+  watchdog: preemption, torn saves, NaN steps, and corrupt records as
+  tested code paths.
+* ``compat``    — JAX version shims (``shard_map`` API move, ambient-mesh
+  helpers) so one codebase spans the supported JAX range.
 * ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.
 * ``utils``     — logging, profiling/tracing (``utils.profiling``), TPU perf
   defaults (``utils.tpu``).
@@ -26,6 +33,15 @@ offline evaluator — rebuilt TPU-first:
 
 __version__ = "0.2.0"
 
+from distributed_training_pytorch_tpu.checkpoint import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    CorruptCheckpointError,
+)
+from distributed_training_pytorch_tpu.fault import (  # noqa: F401
+    FaultPlan,
+    StepWatchdog,
+)
 from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
     setup_distributed,
     create_mesh,

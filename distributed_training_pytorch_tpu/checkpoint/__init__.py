@@ -1,6 +1,8 @@
 from distributed_training_pytorch_tpu.checkpoint.manager import (  # noqa: F401
     BEST,
     LAST,
+    CheckpointError,
     CheckpointManager,
+    CorruptCheckpointError,
     epoch_checkpoint_name,
 )

@@ -1,0 +1,143 @@
+"""JAX version-compat shims — the one place API moves are absorbed.
+
+The framework targets the modern JAX surface (developed against 0.9), but
+must import and run on any JAX back to 0.4.37 (the oldest the test matrix
+carries). Four APIs moved or appeared between those versions; every call
+site imports them from here instead of from ``jax`` directly:
+
+* ``shard_map`` — promoted out of ``jax.experimental.shard_map`` to
+  ``jax.shard_map``. The promoted API also renamed two parameters: the set
+  of *manual* axes is ``axis_names=`` (the experimental API instead takes
+  ``auto=``, the complementary set of axes left automatic), and replication
+  checking is ``check_vma=`` (experimental: ``check_rep=``). The wrapper
+  accepts the modern spelling and translates when falling back.
+* ``jax.sharding.set_mesh`` — the ambient-mesh context manager. Old JAX
+  spells it ``with mesh:`` (``Mesh`` is itself a context manager that sets
+  the thread-resources env bare ``PartitionSpec``s resolve against).
+* ``jax.sharding.get_abstract_mesh`` — the ambient (possibly abstract) mesh.
+  Old JAX only has the concrete thread-resources mesh; an empty ``Mesh()``
+  means "no ambient mesh", mirroring the modern empty ``AbstractMesh``.
+* ``jax.lax.pcast`` — part of the varying-manual-axes (VMA) type system,
+  which old JAX does not have; there the cast is semantically a no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+try:  # jax >= 0.6: shard_map is a top-level public API
+    from jax import shard_map as _shard_map
+
+    SHARD_MAP_MODERN = True
+except ImportError:  # jax < 0.6: experimental module, auto=/check_rep= spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    SHARD_MAP_MODERN = False
+
+# Partial-manual regions (manual over a strict subset of mesh axes) only
+# work on the modern shard_map: the experimental `auto=` implementation
+# aborts the process inside XLA (IsManualSubgroup CHECK failures) for the
+# collective patterns pipeline/MoE composition needs. Feature-gate instead.
+HAS_PARTIAL_MANUAL = SHARD_MAP_MODERN
+
+# Multi-process CPU collectives: 0.4.x jaxlib's CPU backend rejects
+# multiprocess computations outright ("not implemented on the CPU
+# backend"); known-good on the 0.9 line the framework is developed against.
+HAS_CPU_MULTIPROCESS = getattr(jax, "__version_info__", (0, 0, 0)) >= (0, 6, 0)
+
+# Determinism contract: random bits must not depend on how an array is
+# sharded (TP-vs-DP parity, resume across mesh layouts) — the library's
+# augmentation/dropout reproducibility guarantees are stated under this
+# flag. Modern JAX defaults it on; old JAX needs it flipped (newest JAX
+# removed the flag after hard-enabling the behavior, hence the guard).
+# Deliberate import-time side effect: on old JAX it changes sharded
+# jax.random streams process-wide. Opt out AFTER import with
+# jax.config.update("jax_threefry_partitionable", False) — at the cost of
+# the parity guarantees above.
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except (AttributeError, KeyError):
+    pass
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None, check_vma=None):
+    """``jax.shard_map`` with the modern keyword surface on every JAX.
+
+    ``axis_names`` is the set of mesh axes the body is *manual* over (omit
+    for fully manual, the modern default). ``check_vma`` toggles replication
+    /varying checking. On old JAX these translate to ``auto=`` (complement
+    of ``axis_names``) and ``check_rep=``; partial-manual regions there
+    require replication checking off, so the fallback defaults it off
+    unless explicitly requested.
+    """
+    if SHARD_MAP_MODERN:
+        kwargs: dict[str, Any] = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    kwargs = {"check_rep": bool(check_vma) if check_vma is not None else False}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            # Raise a catchable error instead of letting XLA abort the
+            # process (see HAS_PARTIAL_MANUAL above).
+            raise NotImplementedError(
+                "partial-manual shard_map (manual over "
+                f"{sorted(axis_names)} with {sorted(auto)} left automatic) "
+                "requires jax >= 0.6 (jax.shard_map); this JAX only supports "
+                "fully-manual regions. Gate callers on "
+                "compat.HAS_PARTIAL_MANUAL."
+            )
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def set_mesh(mesh: jax.sharding.Mesh):
+    """Context manager making ``mesh`` the ambient mesh (bare-PartitionSpec
+    resolution for ``with_sharding_constraint`` inside jitted bodies)."""
+    if hasattr(jax.sharding, "set_mesh"):
+        return jax.sharding.set_mesh(mesh)
+    return mesh  # old JAX: Mesh is itself the context manager
+
+
+def get_abstract_mesh():
+    """The ambient mesh, or an empty mesh when none is set. Callers must
+    treat ``axis_names == ()`` as "no ambient mesh" (both eras agree)."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    from jax.interpreters import pxla
+
+    return pxla.thread_resources.env.physical_mesh
+
+
+def manual_axes_of(mesh) -> tuple:
+    """Mesh axes currently *manual* (i.e. we are inside a ``shard_map``
+    region over them). Modern JAX records this on the abstract mesh
+    (``manual_axes``); old JAX instead binds manual axes as axis-env frames
+    during the body trace, so we probe each mesh axis name there."""
+    manual = getattr(mesh, "manual_axes", None)
+    if manual is not None:
+        return tuple(manual)
+    try:
+        from jax._src.core import axis_frame
+    except ImportError:
+        return ()
+    bound = []
+    for name in getattr(mesh, "axis_names", ()) or ():
+        try:
+            axis_frame(name)
+        except Exception:
+            continue
+        bound.append(name)
+    return tuple(bound)
+
+
+def pcast(x, axis_name, *, to: str):
+    """``jax.lax.pcast`` where the VMA type system exists; identity where it
+    does not (pre-VMA JAX has no varying/replicated distinction to cast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

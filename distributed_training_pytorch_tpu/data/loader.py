@@ -54,6 +54,7 @@ class ShardedLoader:
         pad_final: bool = False,
         process_index: int | None = None,
         process_count: int | None = None,
+        skip_corrupt: bool = False,
     ):
         if drop_last and pad_final:
             raise ValueError("drop_last and pad_final are mutually exclusive")
@@ -88,6 +89,19 @@ class ShardedLoader:
         self.prefetch_batches = max(1, int(prefetch_batches))
         self.drop_last = drop_last
         self.pad_final = pad_final
+        # Graceful degradation: a corrupt record (CorruptRecordError, or a
+        # decode ValueError) is deterministically replaced by the next
+        # readable one and counted in ``corrupt_skipped`` instead of failing
+        # the epoch. Sources with their own tolerant batch path (records.py
+        # ``skip_corrupt``) get the flag forwarded so the whole-batch fast
+        # path degrades the same way — note this SETS the attribute on the
+        # caller's source object: don't share one source between a tolerant
+        # loader and a strict reader (build a second source over the same
+        # shards instead; the footer-index read is cheap).
+        self.skip_corrupt = bool(skip_corrupt)
+        self._corrupt_skipped = 0
+        if skip_corrupt and hasattr(source, "skip_corrupt"):
+            source.skip_corrupt = True
         self._epoch = 0
         self._pidx = jax.process_index() if process_index is None else process_index
         self._pcount = jax.process_count() if process_count is None else process_count
@@ -97,6 +111,14 @@ class ShardedLoader:
                 f"{self._pcount} processes"
             )
         self.local_batch_size = self.global_batch_size // self._pcount
+
+    @property
+    def corrupt_skipped(self) -> int:
+        """Total records skipped as corrupt — loader-level substitutions
+        (decode/transform failures) PLUS the source's own tolerant-read count
+        (structural corruption handled inside batch fast paths), so callers
+        see one number regardless of which layer degraded."""
+        return self._corrupt_skipped + int(getattr(self.source, "corrupt_skipped", 0))
 
     def set_epoch(self, epoch: int) -> None:
         """Reseed the epoch permutation — ``sampler.set_epoch`` analog
@@ -120,10 +142,31 @@ class ShardedLoader:
             return rng.permutation(n)
         return np.arange(n)
 
-    def _load_one(self, index: int, epoch: int) -> dict:
+    def _load_one_raw(self, index: int, epoch: int) -> dict:
         record = dict(self.source[int(index)])
         if self.transform is not None and "image" in record:
             record["image"] = self.transform(record["image"], epoch=epoch, index=int(index))
+        return record
+
+    def _load_one(self, index: int, epoch: int) -> dict:
+        if not self.skip_corrupt:
+            return self._load_one_raw(index, epoch)
+        from distributed_training_pytorch_tpu.data.records import (
+            _SKIP_COUNT_LOCK,
+            CorruptRecordError,
+            tolerant_fetch,
+        )
+
+        record, skipped = tolerant_fetch(
+            lambda i: self._load_one_raw(i, epoch),
+            index,
+            len(self.source),
+            # decode/transform failures raise plain ValueError too
+            exceptions=(CorruptRecordError, ValueError),
+        )
+        if skipped:
+            with _SKIP_COUNT_LOCK:  # worker threads bump this concurrently
+                self._corrupt_skipped += skipped
         return record
 
     def _batch_fast_path(self):
@@ -178,6 +221,16 @@ class ShardedLoader:
         return max(0, min(self.global_batch_size, n - batch_index * self.global_batch_size))
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_batches(0)
+
+    def iter_batches(self, start: int = 0) -> Iterator[dict]:
+        """Iterate host-local batches from global batch ``start`` onward.
+
+        ``start > 0`` is the mid-epoch RESUME path: the permutation is a pure
+        function of ``(seed, epoch)``, so skipping happens at the index level
+        — none of the skipped batches' records are read, decoded, or
+        augmented (draining a generator instead would pay the full host
+        pipeline for every discarded batch)."""
         order = self._global_order()
         epoch = self._epoch
         num_batches = len(self)
@@ -203,9 +256,10 @@ class ShardedLoader:
             return rows[self._pidx * L : (self._pidx + 1) * L], mask
 
         fast = self._batch_fast_path()
+        start = max(0, int(start))
 
         if self.num_workers <= 0:
-            for b in range(num_batches):
+            for b in range(start, num_batches):
                 rows, mask = batch_indices(b)
                 yield self._produce_batch(rows, mask, epoch, fast)
             return
@@ -228,10 +282,10 @@ class ShardedLoader:
                     futs = [pool.submit(self._load_one, i, epoch) for i in rows]
                     window.put((futs, mask))
 
-            upto = min(ahead, num_batches)
-            for b in range(upto):
+            upto = min(start + ahead, num_batches)
+            for b in range(start, upto):
                 submit(b)
-            for _ in range(num_batches):
+            for _ in range(num_batches - start):
                 item, mask = window.get()
                 if upto < num_batches:
                     submit(upto)

@@ -28,11 +28,47 @@ from __future__ import annotations
 import glob
 import os
 import struct
+import threading
 from typing import Callable, Iterable, Sequence
 
 import numpy as np
 
 MAGIC = b"DTPR1\x00"
+
+
+class CorruptRecordError(ValueError):
+    """A record is structurally damaged (offset/length outside the shard's
+    payload region) or its payload fails to decode. ``ValueError`` subclass
+    so pre-existing callers that caught decode ``ValueError``\\ s still do."""
+
+
+# One probe bound shared by every tolerant layer: past this many consecutive
+# corrupt records the corpus (not a record) is broken and we fail loudly.
+TOLERANT_PROBE_LIMIT = 9
+
+# Skip counters are bumped from loader worker threads; a shared module lock
+# keeps the read-modify-write atomic (contention is one corrupt record's
+# worth — negligible) without making sources unpicklable.
+_SKIP_COUNT_LOCK = threading.Lock()
+
+
+def tolerant_fetch(fetch, index: int, n: int, *, exceptions=None):
+    """Deterministic skip-and-substitute: try ``fetch((index + k) % n)`` for
+    ``k = 0, 1, ...`` until one succeeds; return ``(value, k)`` where ``k``
+    is the number of corrupt records skipped (the caller's counter delta).
+    Raises :class:`CorruptRecordError` after :data:`TOLERANT_PROBE_LIMIT`
+    consecutive failures."""
+    exceptions = exceptions or (CorruptRecordError,)
+    limit = min(TOLERANT_PROBE_LIMIT, n)
+    last_err: Exception | None = None
+    for k in range(limit):
+        try:
+            return fetch((int(index) + k) % n), k
+        except exceptions as e:
+            last_err = e
+    raise CorruptRecordError(
+        f"{limit} consecutive corrupt records starting at {int(index)}"
+    ) from last_err
 
 
 class RecordFileWriter:
@@ -101,7 +137,14 @@ class RecordFileSource:
     in Python).
     """
 
-    def __init__(self, pattern: str, *, decode: Callable[[bytes], np.ndarray] | None = None, transform=None):
+    def __init__(
+        self,
+        pattern: str,
+        *,
+        decode: Callable[[bytes], np.ndarray] | None = None,
+        transform=None,
+        skip_corrupt: bool = False,
+    ):
         if os.path.isdir(pattern):
             pattern = os.path.join(pattern, "*.rec")
         self.paths = sorted(glob.glob(pattern))
@@ -109,9 +152,16 @@ class RecordFileSource:
             raise FileNotFoundError(f"no record shards match {pattern}")
         self.decode = decode if decode is not None else decode_image_bytes
         self.transform = transform
+        # Graceful degradation (production corpora always contain a few bad
+        # records): when on, a structurally-corrupt record is replaced by the
+        # next readable one (deterministic — same substitute every epoch/run)
+        # and counted in ``corrupt_skipped`` instead of failing the batch.
+        self.skip_corrupt = bool(skip_corrupt)
+        self.corrupt_skipped = 0
         # Per-shard footer indexes; records ordered shard-major.
         self._shard_offsets: list[np.ndarray] = []
         self._shard_base: list[int] = []
+        self._shard_payload_end: list[int] = []  # index_offset: payload region bound
         total = 0
         for path in self.paths:
             with open(path, "rb") as f:
@@ -125,6 +175,7 @@ class RecordFileSource:
                 offsets = np.frombuffer(f.read(8 * count), dtype="<u8")
             self._shard_offsets.append(offsets)
             self._shard_base.append(total)
+            self._shard_payload_end.append(index_offset)
             total += count
         self._len = total
         self._fds: dict[int, int] = {}  # lazy per-shard fds (os.pread access)
@@ -166,12 +217,86 @@ class RecordFileSource:
         shard, local = self._locate(index)
         fd = self._fd(shard)
         offset = int(self._shard_offsets[shard][local])
-        label, length = struct.unpack("<qQ", os.pread(fd, 16, offset))
-        return os.pread(fd, length, offset + 16), int(label)
+        payload_end = self._shard_payload_end[shard]
+        if offset + 16 > payload_end:
+            raise CorruptRecordError(
+                f"{self.describe(index)}: header at {offset} beyond payload "
+                f"region ({payload_end}) — corrupt index or truncated shard"
+            )
+        try:
+            label, length = struct.unpack("<qQ", os.pread(fd, 16, offset))
+        except struct.error as e:  # short pread: shard truncated under us
+            raise CorruptRecordError(f"{self.describe(index)}: truncated header") from e
+        if offset + 16 + length > payload_end:
+            raise CorruptRecordError(
+                f"{self.describe(index)}: payload of {length} bytes at {offset} "
+                f"overruns the payload region ({payload_end}) — truncated shard"
+            )
+        payload = os.pread(fd, length, offset + 16)
+        if len(payload) != length:
+            raise CorruptRecordError(
+                f"{self.describe(index)}: short read ({len(payload)}/{length} bytes)"
+            )
+        return payload, int(label)
+
+    def read_record_tolerant(self, index: int) -> tuple[bytes, int]:
+        """``read_record`` honoring ``skip_corrupt``: a corrupt record is
+        deterministically replaced by the next readable one (bounded probe)
+        and counted in ``corrupt_skipped``."""
+        if not self.skip_corrupt:
+            return self.read_record(index)
+        rec, skipped = tolerant_fetch(self.read_record, index, len(self))
+        if skipped:
+            with _SKIP_COUNT_LOCK:
+                self.corrupt_skipped += skipped
+        return rec
+
+    def _produce_batch_tolerant(self, rows, payloads: list, labels: list, produce):
+        """Run ``produce(payloads) -> images`` with whole-batch decode
+        tolerance: under ``skip_corrupt`` a position whose payload fails to
+        decode (structurally fine, bit-rotted content) is substituted by the
+        next readable neighbor's (payload, label) pair and the produce is
+        retried — the fast path degrades exactly like the per-record path.
+        Without ``skip_corrupt``, re-raises the located error."""
+        from distributed_training_pytorch_tpu.data.native import DecodeError
+
+        n = len(self)
+        shifts: dict[int, int] = {}
+        for _ in range(TOLERANT_PROBE_LIMIT + 1):
+            try:
+                return produce(payloads)
+            except DecodeError as e:
+                if not self.skip_corrupt:
+                    self._raise_located(e, rows)
+                p = e.index
+                s = shifts.get(p, 0)
+                while True:
+                    s += 1
+                    if s > TOLERANT_PROBE_LIMIT:
+                        self._raise_located(e, rows)
+                    try:
+                        payloads[p], labels[p] = self.read_record(
+                            (int(rows[p]) + s) % n
+                        )
+                        break
+                    except CorruptRecordError:
+                        continue
+                shifts[p] = s
+                with _SKIP_COUNT_LOCK:
+                    self.corrupt_skipped += 1
+        self._raise_located(e, rows)
 
     def __getitem__(self, index: int) -> dict:
-        payload, label = self.read_record(int(index))
-        return {"image": self.decode(payload), "label": np.int32(label)}
+        payload, label = self.read_record_tolerant(int(index))
+        try:
+            image = self.decode(payload)
+        except CorruptRecordError:
+            raise
+        except ValueError as e:
+            raise CorruptRecordError(
+                f"failed to decode {self.describe(int(index))}"
+            ) from e
+        return {"image": image, "label": np.int32(label)}
 
     def describe(self, index: int) -> str:
         """Human-locatable name for record ``index`` — shard path + position
@@ -182,7 +307,9 @@ class RecordFileSource:
 
     def _raise_located(self, e, rows):
         """Re-raise a batch-position DecodeError naming the actual record."""
-        raise ValueError(f"failed to decode {self.describe(int(rows[e.index]))}") from None
+        raise CorruptRecordError(
+            f"failed to decode {self.describe(int(rows[e.index]))}"
+        ) from None
 
     def __getstate__(self):
         # fds are not picklable; worker processes reopen lazily.
@@ -224,29 +351,29 @@ class NativeRecordFileSource(RecordFileSource):
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         from distributed_training_pytorch_tpu.data.native import mixed_native_batch
 
-        payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
-        labels = np.asarray(labels, np.int32)
+        payloads, labels = map(
+            list, zip(*(self.read_record_tolerant(int(i)) for i in rows))
+        )
         if self._native is not None:
-            from distributed_training_pytorch_tpu.data.native import DecodeError
 
-            try:
-                images = mixed_native_batch(
+            def produce(pls):
+                return mixed_native_batch(
                     len(rows),
                     self.height,
                     self.width,
-                    self._native_positions(payloads),
+                    self._native_positions(pls),
                     lambda pos: self._native.decode_resize_normalize_bytes(
-                        [payloads[p] for p in pos], self.height, self.width, self.mean, self.std
+                        [pls[p] for p in pos], self.height, self.width, self.mean, self.std
                     ),
-                    lambda p: self._py_transform(self.decode(payloads[p])),
+                    lambda p: self._py_transform(self.decode(pls[p])),
                 )
-            except DecodeError as e:
-                self._raise_located(e, rows)
+
+            images = self._produce_batch_tolerant(rows, payloads, labels, produce)
         else:
             images = np.stack(
                 [self._py_transform(self.decode(p)) for p in payloads]
             )
-        return {"image": images, "label": labels}
+        return {"image": images, "label": np.asarray(labels, np.int32)}
 
 
 class NativeRecordTrainSource(RecordFileSource):
@@ -387,19 +514,18 @@ class NativeRecordTrainSource(RecordFileSource):
         )
 
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
-        from distributed_training_pytorch_tpu.data.native import DecodeError
-
-        payloads, labels = zip(*(self.read_record(int(i)) for i in rows))
+        payloads, labels = map(
+            list, zip(*(self.read_record_tolerant(int(i)) for i in rows))
+        )
         if self.train and self.aug == "rrc":
-            try:
-                images = self._load_batch_rrc(payloads, rows, epoch)
-            except DecodeError as e:
-                self._raise_located(e, rows)
+            images = self._produce_batch_tolerant(
+                rows, payloads, labels,
+                lambda pls: self._load_batch_rrc(pls, rows, epoch),
+            )
             return {"image": images, "label": np.asarray(labels, np.int32)}
-        try:
-            images = self._decode_u8(payloads)
-        except DecodeError as e:
-            self._raise_located(e, rows)
+        images = self._produce_batch_tolerant(
+            rows, payloads, labels, self._decode_u8
+        )
         if self.train:
             idx = np.asarray(rows, np.int64)
             if self._native is not None:

@@ -1,0 +1,22 @@
+"""Fault-tolerance subsystem: deterministic fault injection + hung-step watchdog.
+
+Production TPU fleets preempt, lose filesystems mid-write, and feed training
+jobs the occasional corrupt record; this package makes every one of those
+paths *testable in-process on CPU*:
+
+* :mod:`~.inject` — :class:`FaultPlan`, a deterministic schedule of injected
+  failures (SIGTERM mid-epoch, transient checkpoint-write errors, corrupt
+  checkpoint on disk, corrupt data records, NaN loss, hung steps) consumed by
+  the trainer / checkpoint manager / data sources at their injection points.
+* :mod:`~.watchdog` — :class:`StepWatchdog`, a wall-clock monitor that turns
+  a hung step into a preemption-style save instead of a silent stall.
+"""
+
+from distributed_training_pytorch_tpu.fault.inject import (  # noqa: F401
+    CorruptingSource,
+    FaultEvent,
+    FaultPlan,
+    InjectedFault,
+    corrupt_checkpoint,
+)
+from distributed_training_pytorch_tpu.fault.watchdog import StepWatchdog  # noqa: F401

@@ -1,0 +1,194 @@
+"""Deterministic fault injection — recovery paths as first-class test targets.
+
+A :class:`FaultPlan` is a schedule of :class:`FaultEvent`\\ s, each naming an
+injection point (``kind``) plus optional match criteria (epoch, step) and a
+firing budget (``count``). Components that own a recovery path query the plan
+at their injection point and act only when an event matches — with no plan
+(the production default) every query is a cheap ``None`` check.
+
+Injection points wired into the framework:
+
+=====================  ======================================================
+``"sigterm"``          ``Trainer.train_epoch`` sends the process a real
+                       SIGTERM at (epoch, step) — exercising the actual
+                       preemption handler, collective flag vote, and
+                       resumable mid-epoch save.
+``"nan_loss"``         ``Trainer.train_epoch`` poisons the batch's floating
+                       leaves with NaN before the step — exercising the
+                       engine's non-finite guard and the trainer's
+                       ``nan_policy``.
+``"hang"``             ``Trainer.train_epoch`` sleeps ``payload`` seconds at
+                       the step — exercising the :class:`~.watchdog.
+                       StepWatchdog` hung-step path.
+``"checkpoint_write"`` ``CheckpointManager`` raises :class:`InjectedFault`
+                       (an ``OSError``) at save initiation — exercising the
+                       bounded-retry/backoff path. ``count=N`` fails the
+                       first N attempts.
+``"corrupt_checkpoint"`` ``CheckpointManager`` corrupts the checkpoint it
+                       just committed (via :func:`corrupt_checkpoint`) —
+                       exercising integrity validation and the
+                       newest-valid-fallback restore.
+``"corrupt_record"``   :class:`CorruptingSource` raises
+                       ``data.records.CorruptRecordError`` for matching
+                       record indices — exercising loader skip-and-count.
+=====================  ======================================================
+
+Determinism: events match on exact (epoch, step) when given, fire at most
+``count`` times, and the plan records every firing in ``fired`` — a test can
+assert both that the fault happened and that recovery followed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Any
+
+
+class InjectedFault(OSError):
+    """A simulated transient I/O failure (retryable, like ENOSPC or a blip
+    on a network filesystem)."""
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One scheduled failure. ``epoch``/``step`` of ``None`` match anything;
+    ``count`` is the remaining firing budget (decremented per firing)."""
+
+    kind: str
+    epoch: int | None = None
+    step: int | None = None
+    count: int = 1
+    payload: Any = None
+
+
+class FaultPlan:
+    """A deterministic schedule of failures, queried at injection points.
+
+    Build with :meth:`add` (chainable)::
+
+        plan = (FaultPlan()
+                .add("sigterm", epoch=0, step=3)
+                .add("checkpoint_write", count=2))
+    """
+
+    def __init__(self, events: tuple[FaultEvent, ...] | list | None = None):
+        self.events: list[FaultEvent] = list(events or [])
+        self.fired: list[tuple[str, dict]] = []
+
+    def add(
+        self,
+        kind: str,
+        *,
+        epoch: int | None = None,
+        step: int | None = None,
+        count: int = 1,
+        payload: Any = None,
+    ) -> "FaultPlan":
+        self.events.append(
+            FaultEvent(kind, epoch=epoch, step=step, count=count, payload=payload)
+        )
+        return self
+
+    def fires(
+        self, kind: str, *, epoch: int | None = None, step: int | None = None
+    ) -> FaultEvent | None:
+        """Consume and return the first matching event with budget left,
+        else ``None``. A criterion set on the event must equal the queried
+        value; unset criteria match anything."""
+        for ev in self.events:
+            if ev.kind != kind or ev.count <= 0:
+                continue
+            if ev.epoch is not None and ev.epoch != epoch:
+                continue
+            if ev.step is not None and ev.step != step:
+                continue
+            ev.count -= 1
+            self.fired.append((kind, {"epoch": epoch, "step": step}))
+            return ev
+        return None
+
+    def count_fired(self, kind: str) -> int:
+        return sum(1 for k, _ in self.fired if k == kind)
+
+    # -- injection-point helpers ------------------------------------------
+
+    def maybe_raise(self, kind: str, **ctx) -> None:
+        """Raise :class:`InjectedFault` when an event matches (checkpoint
+        write-failure injection point)."""
+        ev = self.fires(kind, **ctx)
+        if ev is not None:
+            raise InjectedFault(
+                f"injected {kind} fault"
+                + (f" (payload={ev.payload!r})" if ev.payload is not None else "")
+            )
+
+    def maybe_sigterm(self, *, epoch: int, step: int) -> bool:
+        """Deliver a real SIGTERM to this process when scheduled — the same
+        signal a cloud scheduler sends ahead of eviction."""
+        if self.fires("sigterm", epoch=epoch, step=step) is None:
+            return False
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+
+
+def corrupt_checkpoint(path: str, *, mode: str = "truncate") -> str:
+    """Damage a committed checkpoint directory in place; returns the file hit.
+
+    ``mode="truncate"`` halves the largest file (a torn write — the classic
+    crash-during-save artifact); ``"flip"`` inverts one byte mid-file (silent
+    media/transfer corruption); ``"delete"`` removes the file entirely.
+    """
+    if mode not in ("truncate", "flip", "delete"):
+        raise ValueError(f"mode must be truncate|flip|delete, got {mode!r}")
+    victim, size = None, -1
+    for dirpath, _, files in os.walk(path):
+        for f in files:
+            if f == "manifest.dtp.json":
+                # corrupt checkpoint DATA, not the integrity manifest — a torn
+                # write damages payload bytes; the manifest is tiny and fsync'd
+                continue
+            fp = os.path.join(dirpath, f)
+            s = os.path.getsize(fp)
+            if s > size:
+                victim, size = fp, s
+    if victim is None:
+        raise FileNotFoundError(f"no files to corrupt under {path}")
+    if mode == "truncate":
+        with open(victim, "rb+") as f:
+            f.truncate(max(0, size // 2))
+    elif mode == "flip":
+        with open(victim, "rb+") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+    else:
+        os.remove(victim)
+    return victim
+
+
+class CorruptingSource:
+    """Wrap a data source so scheduled records read as corrupt.
+
+    Matching uses the plan's ``step`` criterion as the *record index*. The
+    raised error is :class:`~distributed_training_pytorch_tpu.data.records.
+    CorruptRecordError`, exactly what a truncated/garbled record produces —
+    so the loader's skip-and-count path sees the real exception type.
+    """
+
+    def __init__(self, source, plan: FaultPlan):
+        self.source = source
+        self.plan = plan
+        self.transform = getattr(source, "transform", None)
+
+    def __len__(self) -> int:
+        return len(self.source)
+
+    def __getitem__(self, index: int):
+        from distributed_training_pytorch_tpu.data.records import CorruptRecordError
+
+        if self.plan.fires("corrupt_record", step=int(index)) is not None:
+            raise CorruptRecordError(f"injected corrupt record at index {int(index)}")
+        return self.source[index]

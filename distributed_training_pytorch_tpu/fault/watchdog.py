@@ -1,0 +1,124 @@
+"""Wall-clock hung-step watchdog.
+
+A training job can stall without dying: a wedged storage mount blocks the
+input pipeline, a peer drops out of a collective and everyone else spins in
+it, a flaky interconnect link hangs a transfer. Nothing raises — the job
+just stops making progress until the scheduler's (much longer) job timeout
+reaps it, losing everything since the last checkpoint.
+
+:class:`StepWatchdog` bounds that loss: the step loop ``pat()``\\ s it once
+per step from the main thread; a daemon thread checks elapsed time since the
+last pat and, past ``timeout`` seconds, invokes ``on_timeout`` — by default
+sending this process a SIGTERM, which the ``Trainer``'s preemption handler
+already turns into a resumable mid-epoch save at the next safe point. The
+hang and the recovery reuse the preemption machinery rather than inventing a
+second save path.
+
+The watchdog never acts from signal context and never touches JAX state from
+its thread — it only observes timestamps and fires the callback.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+
+def _default_on_timeout() -> None:
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+class StepWatchdog:
+    """Fire ``on_timeout`` when no ``pat()`` arrives for ``timeout`` seconds.
+
+    ``on_timeout`` runs on the watchdog thread, at most ``max_fires`` times
+    (default once — a hung step does not need a SIGTERM storm). Use as a
+    context manager around a step loop::
+
+        with StepWatchdog(timeout=300) as dog:
+            for batch in batches:
+                step(batch)
+                dog.pat()
+    """
+
+    def __init__(
+        self,
+        timeout: float,
+        on_timeout: Optional[Callable[[], None]] = None,
+        *,
+        poll_interval: float | None = None,
+        max_fires: int = 1,
+        escalation_factor: float = 5.0,
+    ):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be positive, got {timeout}")
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout if on_timeout is not None else _default_on_timeout
+        self.poll_interval = (
+            poll_interval if poll_interval is not None else min(1.0, self.timeout / 4)
+        )
+        self.max_fires = max_fires
+        # After a fire, the NEXT window is timeout * escalation_factor: the
+        # first fire's recovery (SIGTERM -> flag -> break -> save) needs the
+        # in-flight step to finish; escalating only declares the thread
+        # wedged after that grace multiple passes with no pat.
+        self.escalation_factor = float(escalation_factor)
+        self.fired = 0
+        self._pats = 0
+        self._last_pat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is not None:
+            return self
+        self._last_pat = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def pat(self) -> None:
+        """Mark progress (call once per completed step)."""
+        self._pats += 1
+        self._last_pat = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def elapsed(self) -> float:
+        return time.monotonic() - self._last_pat
+
+    def _run(self) -> None:
+        window = self.timeout
+        pats_at_fire = -1
+        while not self._stop.wait(self.poll_interval):
+            if self.fired >= self.max_fires:
+                return
+            if pats_at_fire >= 0 and self._pats > pats_at_fire:
+                window = self.timeout  # a REAL pat since the fire: de-escalate
+                pats_at_fire = -1
+            if self.elapsed > window:
+                self.fired += 1
+                pats_at_fire = self._pats
+                try:
+                    self.on_timeout()
+                except Exception:
+                    pass  # the watchdog must never take the process down itself
+                self._last_pat = time.monotonic()  # re-arm window for next fire
+                window = self.timeout * self.escalation_factor
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

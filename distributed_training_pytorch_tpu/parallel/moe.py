@@ -47,6 +47,7 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
 
 __all__ = [
@@ -82,11 +83,11 @@ def _constrain(x: jax.Array, axes: tuple, *, activation: bool = False) -> jax.Ar
     (spmd_partitioner_util.cc "partition_group_list ... num_devices_per_group",
     bisected on jax 0.9/CPU) — so they are skipped there, and expert layout
     flows from the weights."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     mesh_axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
     if not mesh_axes:
         return x
-    if activation and getattr(mesh, "manual_axes", ()):
+    if activation and compat.manual_axes_of(mesh):
         return x
     spec = P(*[a if (a is not None and a in mesh_axes) else None for a in axes])
     return jax.lax.with_sharding_constraint(x, spec)
@@ -382,13 +383,13 @@ def manual_expert_mlp(
     ``num_experts`` by ``expert_size``. Differentiable; aux losses are not
     sow'd on this path (compute them from a separate router call if needed).
     """
-    from jax import shard_map
+    from distributed_training_pytorch_tpu.compat import shard_map
 
     # Inside a traced context the shard_map must receive the ambient ABSTRACT
     # mesh (it carries e.g. pipe's Manual axis type from an enclosing
     # pipeline_apply region); the concrete mesh arg is the fallback for
     # un-nested use outside set_mesh.
-    ctx = jax.sharding.get_abstract_mesh()
+    ctx = compat.get_abstract_mesh()
     if ctx is not None and getattr(ctx, "axis_names", ()):
         mesh = ctx
     elif mesh is None:
@@ -461,7 +462,7 @@ def manual_expert_mlp(
             top_k=top_k, capacity=capacity, dtype=dtype,
         )
 
-    if getattr(mesh, "manual_axes", ()):
+    if compat.manual_axes_of(mesh):
         raise ValueError(
             "manual_expert_mlp cannot nest inside an enclosing shard_map "
             "(Shardy rejects both re-binding a parent's manual axis and a "

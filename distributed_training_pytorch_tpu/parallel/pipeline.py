@@ -53,10 +53,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-# jax >= 0.9: top-level shard_map with `axis_names` (partial-manual mode,
-# which pipeline_apply requires — the old experimental shard_map's auto=
-# parameter has different semantics, so no fallback import is kept).
-from jax import shard_map
+# Partial-manual shard_map (`axis_names`): the compat shim maps it onto the
+# old experimental API's complementary `auto=` parameter on pre-0.6 JAX.
+from distributed_training_pytorch_tpu.compat import pcast, shard_map
 
 from distributed_training_pytorch_tpu.parallel.mesh import PIPE_AXIS
 
@@ -296,7 +295,7 @@ def pipeline_apply(
         # (each stage holds different activations), so the init must carry the
         # same varying-over-`axis` type or scan rejects the carry signature.
         def _vary(x):
-            return jax.lax.pcast(x, axis, to="varying")
+            return pcast(x, axis, to="varying")
 
         init = (
             _vary(jnp.zeros(act_shape, act_dtype)),

@@ -28,7 +28,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
+from distributed_training_pytorch_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_training_pytorch_tpu.parallel.mesh import SEQ_AXIS

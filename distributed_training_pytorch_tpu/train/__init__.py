@@ -1,5 +1,6 @@
 from distributed_training_pytorch_tpu.train.state import TrainState  # noqa: F401
 from distributed_training_pytorch_tpu.train.engine import (  # noqa: F401
+    NonFiniteLossError,
     TrainEngine,
     make_supervised_loss,
 )

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel import sharding as sharding_lib
 from distributed_training_pytorch_tpu.train.state import TrainState
@@ -33,6 +34,11 @@ from distributed_training_pytorch_tpu.train.state import TrainState
 # A LossFn maps (params, model_state, batch, rng, train) ->
 #   (loss, (metrics dict, new_model_state)).
 LossFn = Callable[[Any, Any, Any, jax.Array, bool], tuple[jax.Array, tuple[Mapping, Any]]]
+
+
+class NonFiniteLossError(FloatingPointError):
+    """Raised by the trainer's ``nan_policy="raise"`` when a step produced a
+    non-finite loss (the functional analog of torch's anomaly detection)."""
 
 
 def make_supervised_loss(model, criterion: Callable) -> LossFn:
@@ -79,12 +85,20 @@ class TrainEngine:
         donate_state: bool = True,
         sharding_rules: Sequence | None = None,
         fsdp_min_size: int = 2**18,
+        nan_guard: bool = False,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.accum_steps = int(accum_steps)
         self.schedule = schedule
+        # Non-finite step guard (graceful-degradation support): when on, a
+        # step whose loss or grads contain NaN/Inf leaves params/opt_state/
+        # model_state UNTOUCHED (step and rng still advance, so the data and
+        # dropout streams move past the poison batch) and reports
+        # metrics["nonfinite"]=1. All inside the compiled step — no host
+        # sync. Off by default: the where-select touches every state leaf.
+        self.nan_guard = bool(nan_guard)
         self.sharding_rules = sharding_rules
         self.fsdp_min_size = fsdp_min_size
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
@@ -234,13 +248,24 @@ class TrainEngine:
         grads, loss, metrics, new_ms = self._grads_and_metrics(state, batch, step_rng)
         updates, new_opt_state = self.optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        if self.nan_guard:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                ok &= jnp.all(jnp.isfinite(g))
+            keep = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda n, o: jnp.where(ok, n, o), new, old
+            )
+            new_params = keep(new_params, state.params)
+            new_opt_state = keep(new_opt_state, state.opt_state)
+            new_ms = keep(new_ms, state.model_state)
+            metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             opt_state=new_opt_state,
             model_state=new_ms,
         )
-        metrics = dict(metrics)
         metrics.setdefault("loss", loss)
         if self.schedule is not None:
             metrics["lr"] = self.schedule(state.step)
@@ -262,7 +287,7 @@ class TrainEngine:
         buffers) — those resolve against the ambient mesh, which plain
         ``jax.jit`` with explicit NamedShardings does NOT establish. Without
         this, in-model constraints would silently no-op on the engine path."""
-        return jax.sharding.set_mesh(self.mesh)
+        return compat.set_mesh(self.mesh)
 
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         """One compiled optimizer step on a global batch. Metrics are device

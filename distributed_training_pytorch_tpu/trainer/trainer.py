@@ -60,8 +60,13 @@ from distributed_training_pytorch_tpu.checkpoint import (
     epoch_checkpoint_name,
 )
 from distributed_training_pytorch_tpu.data import ShardedLoader, device_prefetch
+from distributed_training_pytorch_tpu.fault.watchdog import StepWatchdog
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
-from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+from distributed_training_pytorch_tpu.train import (
+    NonFiniteLossError,
+    TrainEngine,
+    make_supervised_loss,
+)
 from distributed_training_pytorch_tpu.utils.tensorboard import MetricsWriter
 
 
@@ -100,6 +105,10 @@ class Trainer:
         preemption_check_every: int = 20,
         max_checkpoints_to_keep: int | None = None,
         tensorboard_dir: str | None = None,
+        nan_policy: str | None = None,
+        skip_corrupt_records: bool = False,
+        step_timeout: float | None = None,
+        fault_plan=None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -156,6 +165,36 @@ class Trainer:
         # Optional TensorBoard scalars (SURVEY §5.5 upgrade; process 0 only).
         self.metrics_writer = MetricsWriter(tensorboard_dir)
 
+        # Graceful degradation (fault/ subsystem). nan_policy governs steps
+        # whose loss/grads go non-finite:
+        #   None                 — legacy behavior: train on, no guard;
+        #   "raise"              — NonFiniteLossError at the next host sync
+        #                          point (log_every / epoch end);
+        #   "skip"               — the engine guard drops the update (params
+        #                          untouched, step advances), counted in
+        #                          self.nonfinite_steps;
+        #   "restore_last_good"  — like "skip", plus the state is rolled back
+        #                          to the newest VALID checkpoint at the next
+        #                          host sync point after a poisoned step.
+        if nan_policy not in (None, "raise", "skip", "restore_last_good"):
+            raise ValueError(
+                f"nan_policy must be None|raise|skip|restore_last_good, got {nan_policy!r}"
+            )
+        self.nan_policy = nan_policy
+        self.nonfinite_steps = 0
+        self.nonfinite_rollbacks = 0
+        self.skip_corrupt_records = skip_corrupt_records
+        # Wall-clock hung-step watchdog: past `step_timeout` seconds without
+        # a completed step, SIGTERM ourselves — the preemption handler then
+        # turns the hang into a resumable save at the next safe point.
+        self.step_timeout = step_timeout
+        # Deterministic fault injection (tests; None in production).
+        self.fault_plan = fault_plan
+        # Mid-epoch resume position (set when restoring a preemption save's
+        # loop state; consumed by the first trained epoch).
+        self._resume_step_in_epoch = 0
+        self._interrupted_at_step = 0
+
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
         self.save_folder = save_folder
         self.save_weight_folder = os.path.join(save_folder, "weights")
@@ -164,6 +203,7 @@ class Trainer:
             save_best_for=save_best_for,
             async_save=async_checkpoint,
             max_to_keep=max_checkpoints_to_keep,
+            fault_plan=fault_plan,
         )
 
         # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE
@@ -201,6 +241,7 @@ class Trainer:
             self.mesh,
             accum_steps=accum_steps,
             schedule=self.schedule,
+            nan_guard=self.nan_policy in ("skip", "restore_last_good"),
         )
 
         # State init (replaces model.to(device) + DDP param broadcast).
@@ -210,10 +251,36 @@ class Trainer:
             lambda rng: self.model.init(rng, example),
         )
 
-        # Snapshot resume (``:44-45,96-101``).
+        # Snapshot resume (``:44-45,96-101``). "latest_valid" resolves to the
+        # newest checkpoint that passes integrity validation — the automatic
+        # restart-after-preemption entry point (a torn last save falls back
+        # to the previous good one instead of crashing the resume).
+        if snapshot_path == "latest_valid" and not self.checkpoints.checkpoint_names():
+            # The automatic-restart entry point must be idempotent: on the
+            # very first launch there is nothing to resume — cold start.
+            self.log("no checkpoint to resume (latest_valid) — starting fresh")
+            snapshot_path = None
         if snapshot_path is not None:
-            self.state, self.cur_epoch = self.checkpoints.restore(snapshot_path, self.state)
-            self.log(f"Resumed from {snapshot_path} at epoch {self.cur_epoch}")
+            if snapshot_path == "latest_valid":
+                self.state, self.cur_epoch, snapshot_path = (
+                    self.checkpoints.restore_latest_valid(self.state)
+                )
+            else:
+                self.state, self.cur_epoch = self.checkpoints.restore(
+                    snapshot_path, self.state
+                )
+            meta = self.checkpoints.read_meta(snapshot_path)
+            self._resume_step_in_epoch = int(
+                (meta.get("loop") or {}).get("step_in_epoch", 0)
+            )
+            self.log(
+                f"Resumed from {snapshot_path} at epoch {self.cur_epoch}"
+                + (
+                    f", step {self._resume_step_in_epoch} (mid-epoch)"
+                    if self._resume_step_in_epoch
+                    else ""
+                )
+            )
 
     # ------------------------------------------------------------------
     # Framework-provided machinery (overridable, like ``build_dataloader``
@@ -237,6 +304,7 @@ class Trainer:
             prefetch_batches=self.prefetch_batches,
             drop_last=train,
             pad_final=not train,
+            skip_corrupt=self.skip_corrupt_records,
         )
 
     def build_example_input(self) -> jax.Array:
@@ -302,11 +370,27 @@ class Trainer:
             if self._collective_preempt_flag():
                 self._preempted = True
                 resume_epoch = epoch if self._epoch_interrupted else epoch + 1
-                self.checkpoints.save(LAST, self.state, resume_epoch)
+                # A mid-epoch interruption records its position so the resume
+                # skips the already-trained batches (bit-exact continuation);
+                # an epoch-boundary save restarts the next epoch at step 0.
+                loop_state = (
+                    {"step_in_epoch": self._interrupted_at_step}
+                    if self._epoch_interrupted
+                    else None
+                )
+                self.checkpoints.save(
+                    LAST, self.state, resume_epoch, loop_state=loop_state
+                )
                 self.checkpoints.wait()
                 self.log(
                     f"SIGTERM received — saved resumable snapshot (epoch "
-                    f"{resume_epoch}) to {self.checkpoints.path(LAST)}; exiting",
+                    f"{resume_epoch}"
+                    + (
+                        f", step {self._interrupted_at_step}"
+                        if self._epoch_interrupted
+                        else ""
+                    )
+                    + f") to {self.checkpoints.path(LAST)}; exiting",
                     "warning",
                 )
                 return
@@ -341,51 +425,215 @@ class Trainer:
     def train_epoch(self, epoch: int) -> dict:
         """Inner hot loop: compiled step per global batch, device-resident
         metrics (no per-step host sync — the reference pays a ``loss.item()``
-        sync every step, ``example_trainer.py:89``)."""
+        sync every step, ``example_trainer.py:89``).
+
+        Mid-epoch resume: when this epoch was interrupted by a preemption
+        save at step k, the first k batches are skipped (the loader's
+        permutation and the per-(epoch, index) augmentation keys are
+        deterministic, so the surviving stream is identical to the one the
+        interrupted run would have seen) — the resumed run stays bit-exact
+        with an uninterrupted one."""
         collected: list[Any] = []
-        step_in_epoch = 0
+        skip_steps = self._resume_step_in_epoch
+        self._resume_step_in_epoch = 0  # consumed by the first trained epoch
+        step_in_epoch = skip_steps
+        executed = 0
+        synced = 0  # index into `collected` of the last nan-policy sync
         t0 = time.perf_counter()
-        batches = device_prefetch(
-            (self._check_image_range(self.preprocess_batch(b)) for b in self.train_dataloader),
-            self.mesh,
+        # Resume skip happens at the loader's INDEX level when it can
+        # (iter_batches: none of the skipped batches are read or decoded);
+        # generic iterables fall back to drain-and-discard.
+        if skip_steps and hasattr(self.train_dataloader, "iter_batches"):
+            source_iter = self.train_dataloader.iter_batches(skip_steps)
+        elif skip_steps:
+            import itertools
+
+            source_iter = itertools.islice(iter(self.train_dataloader), skip_steps, None)
+        else:
+            source_iter = iter(self.train_dataloader)
+        host_batches = (
+            self._check_image_range(self.preprocess_batch(b)) for b in source_iter
         )
+        batches = device_prefetch(host_batches, self.mesh)
         bar = self._progress_bar(len(self.train_dataloader), f"epoch {epoch + 1}")
         self._epoch_interrupted = False
-        for batch in batches:
-            if self._preemption_requested(step_in_epoch):
-                self._preempted = True  # collective decision (multi-host OR)
-                self._epoch_interrupted = True
-                break
-            self._maybe_profile(step_in_epoch)
-            self.state, metrics = self.train_step(self.state, batch)
-            collected.append(metrics)
-            step_in_epoch += 1
-            if bar is not None:
-                # Advancing the bar is host-only; the postfix refreshes at the
-                # log_every sync points (a true per-step live loss would force
-                # the reference's per-step loss.item() sync back in).
-                bar.update(1)
-            if self.log_every and step_in_epoch % self.log_every == 0:
-                # Intra-epoch host syncs: this (every log_every steps) and,
-                # multi-host only, the preemption vote (_preemption_requested).
-                m = {k: float(v) for k, v in collected[-1].items()}
-                rate = step_in_epoch * self.batch_size / (time.perf_counter() - t0)
+        # Armed only after the FIRST completed step of the epoch: the first
+        # step includes XLA compilation (minutes for a real model) — arming
+        # before it would SIGTERM mid-compile, and the resumed run would
+        # recompile and die the same way: a restart livelock.
+        watchdog = None
+        try:
+            for batch in batches:
+                if self.fault_plan is not None:
+                    batch = self._inject_step_faults(batch, epoch, step_in_epoch)
+                if self._preemption_requested(step_in_epoch):
+                    self._preempted = True  # collective decision (multi-host OR)
+                    self._epoch_interrupted = True
+                    self._interrupted_at_step = step_in_epoch
+                    break
+                self._maybe_profile(step_in_epoch)
+                self.state, metrics = self.train_step(self.state, batch)
+                collected.append(metrics)
+                step_in_epoch += 1
+                executed += 1
+                if self.step_timeout:
+                    if watchdog is None:
+                        # max_fires=2: fire 1 = graceful SIGTERM save; fire 2
+                        # = the thread is wedged, hard-exit (_on_hung_step).
+                        watchdog = StepWatchdog(
+                            self.step_timeout, self._on_hung_step, max_fires=2
+                        ).start()
+                    watchdog.pat()
                 if bar is not None:
-                    bar.set_postfix(m, refresh=False)
-                    bar.clear()  # keep log lines off the live bar row
-                self.log(
-                    f"  step {step_in_epoch}/{len(self.train_dataloader)} "
-                    f"{m} ({rate:.1f} img/s)"
-                )
-                if bar is not None:
-                    bar.refresh()
+                    # Advancing the bar is host-only; the postfix refreshes at the
+                    # log_every sync points (a true per-step live loss would force
+                    # the reference's per-step loss.item() sync back in).
+                    bar.update(1)
+                if self.log_every and step_in_epoch % self.log_every == 0:
+                    # Intra-epoch host syncs: this (every log_every steps) and,
+                    # multi-host only, the preemption vote (_preemption_requested).
+                    m = {k: float(v) for k, v in collected[-1].items()}
+                    if "nonfinite" in m:
+                        # The policy check must see every step since the last
+                        # sync, not just the latest — a guarded poison at step
+                        # k<now has nonfinite=1 only in ITS metrics.
+                        m_check = dict(m)
+                        m_check["nonfinite"] = float(
+                            np.sum([float(x["nonfinite"]) for x in collected[synced:]])
+                        )
+                        synced = len(collected)
+                        self._apply_nan_policy(m_check)
+                    else:
+                        self._apply_nan_policy(m)
+                    rate = executed * self.batch_size / (time.perf_counter() - t0)
+                    if bar is not None:
+                        bar.set_postfix(m, refresh=False)
+                        bar.clear()  # keep log lines off the live bar row
+                    self.log(
+                        f"  step {step_in_epoch}/{len(self.train_dataloader)} "
+                        f"{m} ({rate:.1f} img/s)"
+                    )
+                    if bar is not None:
+                        bar.refresh()
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         self._maybe_profile(step_in_epoch, end_of_epoch=True)
         if bar is not None:
             bar.close()
         if not collected:
             return {}
         host = jax.device_get(collected)
-        return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+        return self._aggregate_epoch_metrics(host, synced)
+
+    def _aggregate_epoch_metrics(self, host: list[dict], synced: int = 0) -> dict:
+        """Per-epoch means. Under the non-finite guard, poisoned steps are
+        excluded from the means (their loss is NaN by construction — averaging
+        it in would report a NaN epoch even though training recovered) and
+        ``nonfinite`` reports the skipped-step COUNT instead. The policy check
+        covers only steps after the last intra-epoch sync (``synced``) — a
+        poison already handled at a log_every sync must not re-trigger."""
+        if "nonfinite" not in host[0]:
+            out = {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+            self._apply_nan_policy(out)
+            return out
+        bad = int(np.sum([m["nonfinite"] for m in host]))
+        self.nonfinite_steps += bad
+        good = [m for m in host if not m["nonfinite"]]
+        out = {
+            k: float(np.mean([m[k] for m in good])) if good else float("nan")
+            for k in host[0]
+            if k != "nonfinite"
+        }
+        out["nonfinite"] = float(bad)
+        check = dict(out)
+        check["nonfinite"] = float(np.sum([m["nonfinite"] for m in host[synced:]]))
+        self._apply_nan_policy(check)
+        return out
+
+    def _apply_nan_policy(self, host_metrics: dict) -> None:
+        """Run at host sync points only (log_every / epoch end) — detection
+        adds zero extra device syncs. ``host_metrics`` values are floats."""
+        if self.nan_policy is None:
+            return
+        poisoned = host_metrics.get("nonfinite", 0.0) > 0 or any(
+            not np.isfinite(v) for v in host_metrics.values()
+        )
+        if not poisoned:
+            return
+        if self.nan_policy == "raise":
+            raise NonFiniteLossError(
+                f"non-finite training metrics: {host_metrics} "
+                "(nan_policy='raise'; use 'skip' or 'restore_last_good' to "
+                "degrade gracefully)"
+            )
+        if self.nan_policy == "restore_last_good":
+            from distributed_training_pytorch_tpu.checkpoint import CheckpointError
+
+            try:
+                self.state, epoch, name = self.checkpoints.restore_latest_valid(
+                    self.state
+                )
+            except CheckpointError:
+                # Nothing saved yet (NaN before the first checkpoint): the
+                # engine guard already dropped the poisoned update, so
+                # degrading to skip-semantics is safe — and still graceful.
+                self.log(
+                    "non-finite step detected but no valid checkpoint exists "
+                    "yet — update was skipped, training continues",
+                    "warning",
+                )
+                return
+            self.nonfinite_rollbacks += 1
+            self.log(
+                f"non-finite step detected — rolled state back to checkpoint "
+                f"{name!r} (epoch {epoch})",
+                "warning",
+            )
+
+    def _inject_step_faults(self, batch, epoch: int, step: int):
+        """Deterministic fault-injection points (fault/inject.py): a real
+        SIGTERM, a simulated hung step, or a NaN-poisoned batch."""
+        self.fault_plan.maybe_sigterm(epoch=epoch, step=step)
+        hang = self.fault_plan.fires("hang", epoch=epoch, step=step)
+        if hang is not None:
+            time.sleep(float(hang.payload or 0.0))
+        if self.fault_plan.fires("nan_loss", epoch=epoch, step=step) is not None:
+            batch = jax.tree.map(
+                lambda x: jnp.full_like(x, jnp.nan)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                batch,
+            )
+        return batch
+
+    _hung_once = False
+
+    def _on_hung_step(self) -> None:
+        # Watchdog-thread callback. First fire: reuse the preemption
+        # machinery (SIGTERM -> flag -> collective save at the next safe
+        # point) — recovers steps that are slow but eventually return.
+        # Second fire: the main thread is truly wedged (blocked inside a
+        # collective or I/O call that will never return to the loop's
+        # preemption check), so a graceful save is impossible — hard-exit
+        # with EX_TEMPFAIL so the scheduler restarts from the last
+        # checkpoint. That IS the bounded loss; the alternative is a silent
+        # stall until the job-level timeout.
+        if self._hung_once:
+            self.log(
+                f"watchdog: still no progress {self.step_timeout}s after "
+                "SIGTERM — main thread is wedged; hard-exiting for scheduler "
+                "restart (resume from the last checkpoint)",
+                "error",
+            )
+            os._exit(75)  # EX_TEMPFAIL
+        self._hung_once = True
+        self.log(
+            f"watchdog: no step completed in {self.step_timeout}s — forcing a "
+            "preemption-style resumable save",
+            "warning",
+        )
+        os.kill(os.getpid(), signal.SIGTERM)
 
     def _on_preemption_signal(self, signum, frame) -> None:
         # Flag only — saves are collective and cannot run in signal context.

@@ -22,7 +22,17 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["itemize_hlo_matmul_flops", "executed_matmul_flops"]
+__all__ = ["itemize_hlo_matmul_flops", "executed_matmul_flops", "xla_cost_analysis"]
+
+
+def xla_cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one flat dict — old jaxlib
+    returns a single-element list of per-program dicts, new jaxlib the dict
+    itself."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost)
 
 DEF_RE = re.compile(r"^(?:ROOT )?%([\w.\-]+) = \w+\[([0-9,]*)\]")
 CONV_RE = re.compile(r" convolution\((.*?)\), window={(.*?)}, dim_labels=(\S+?)[,\s]")
@@ -117,7 +127,7 @@ def executed_matmul_flops(compiled) -> float | None:
     reconciliation"); comparisons against nominal counts must add the
     kernel's analytic FLOPs back."""
     total = sum(r["flops"] for r in itemize_hlo_matmul_flops(compiled.as_text()))
-    cost = compiled.cost_analysis() or {}
+    cost = xla_cost_analysis(compiled)
     xla = float(cost.get("flops", 0.0))
     if total == 0.0 and xla > 1e9:
         import warnings

@@ -39,7 +39,10 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench
-from distributed_training_pytorch_tpu.utils.hlo_flops import itemize_hlo_matmul_flops
+from distributed_training_pytorch_tpu.utils.hlo_flops import (
+    itemize_hlo_matmul_flops,
+    xla_cost_analysis,
+)
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
 
 
@@ -69,7 +72,7 @@ def main():
     compiled = engine.compile_train_step(
         state, gbatch, compiler_options=setup["compiler_options"]
     )
-    cost = compiled.cost_analysis() or {}
+    cost = xla_cost_analysis(compiled)
     xla_total = float(cost.get("flops", 0.0))
     model_total = cfg["flops"](model, image_size) * batch * cfg["items_per_row"](image_size)
 

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel.moe import MoEMlp
 from distributed_training_pytorch_tpu.parallel.pipeline import (
@@ -83,7 +84,7 @@ def loss(stacked):
 
 
 print("compiling the GSPMD-constraint triple (crashes while the bug exists)...")
-with jax.sharding.set_mesh(mesh):
+with compat.set_mesh(mesh):
     l, _ = jax.jit(jax.value_and_grad(loss))(stacked)
 print(f"NO CRASH (loss {float(l):.3f}) — the upstream CHECK is fixed; the "
       "GSPMD formulation of data x expert x pipe can be re-evaluated.")

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tier-1 verification with a fast import-health gate.
+#
+# Stage 1 runs `pytest --collect-only`: any module that fails to import
+# (a moved JAX API, a broken compat shim, a missing dependency) fails here in
+# seconds, instead of surfacing as a wall of per-module collection ERRORs
+# buried in a multi-minute test run — exactly how the seed's 14 import
+# breakages went unnoticed.
+#
+# Stage 2 is the ROADMAP.md tier-1 command verbatim.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== stage 1/2: import health (pytest --collect-only) =="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
+    -p no:cacheprovider > /tmp/_collect.log 2>&1; then
+  echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
+  grep -aE "ERROR|ImportError|ModuleNotFoundError" /tmp/_collect.log | head -40
+  exit 2
+fi
+tail -1 /tmp/_collect.log
+
+echo "== stage 2/2: tier-1 test suite =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -7,6 +7,7 @@ import numpy as np
 from distributed_training_pytorch_tpu.utils.hlo_flops import (
     executed_matmul_flops,
     itemize_hlo_matmul_flops,
+    xla_cost_analysis,
 )
 
 
@@ -65,7 +66,7 @@ def test_executed_guard_rejects_unreconciled_counts():
     got = executed_matmul_flops(compiled)
     assert got is None or got > 0
     if got is not None:
-        cost = compiled.cost_analysis() or {}
+        cost = xla_cost_analysis(compiled)
         xla = float(cost.get("flops", 0.0))
         if xla:
             assert 0.3 <= got / xla <= 1.1

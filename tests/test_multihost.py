@@ -16,6 +16,8 @@ import sys
 import numpy as np
 import pytest
 
+from distributed_training_pytorch_tpu import compat
+
 _WORKER = r"""
 import os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -73,6 +75,10 @@ mesh_lib.shutdown_distributed()
 
 
 @pytest.mark.skipif(os.name != "posix", reason="subprocess workers")
+@pytest.mark.skipif(
+    not compat.HAS_CPU_MULTIPROCESS,
+    reason="this jaxlib's CPU backend cannot run multiprocess computations",
+)
 def test_two_process_distributed_train(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel.pipeline import (
     PIPE_AXIS,
@@ -302,6 +303,10 @@ def test_pipeline_interleaved_rejects_indivisible(pipe_mesh):
         pipeline_apply(stages, micro, stage_fn, pipe_mesh, n_virtual=2)
 
 
+@pytest.mark.skipif(
+    not compat.HAS_PARTIAL_MANUAL,
+    reason="partial-manual shard_map needs jax>=0.6 (experimental auto= aborts in XLA)",
+)
 @pytest.mark.parametrize("combo", ["data", "expert", "tensor"])
 def test_pipeline_composes_on_one_mesh(devices, combo):
     """Matrix composition on ONE multi-axis mesh (r3 VERDICT item 7):
@@ -386,7 +391,7 @@ def test_pipeline_composes_on_one_mesh(devices, combo):
         out = pipeline_apply(stacked, fed, stage_body, mesh)
         return jnp.sum(out**2)
 
-    with jax.sharding.set_mesh(mesh):
+    with compat.set_mesh(mesh):
         loss, grads = jax.jit(jax.value_and_grad(pipe_loss))(stacked)
 
     def seq_loss(stacked):
@@ -405,6 +410,10 @@ def test_pipeline_composes_on_one_mesh(devices, combo):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 
+@pytest.mark.skipif(
+    not compat.HAS_PARTIAL_MANUAL,
+    reason="partial-manual shard_map needs jax>=0.6 (experimental auto= aborts in XLA)",
+)
 def test_pipeline_triple_data_expert_pipe(devices):
     """The data x expert x pipe TRIPLE (r4 VERDICT item 7): GSPMD's
     constraint-driven expert sharding CHECK-crashes inside the pipe-manual
@@ -461,7 +470,7 @@ def test_pipeline_triple_data_expert_pipe(devices):
             ) ** 2
         )
 
-    with jax.sharding.set_mesh(mesh):
+    with compat.set_mesh(mesh):
         l, g = jax.jit(jax.value_and_grad(loss))(stacked)
 
     def stage_ref(p, x):

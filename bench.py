@@ -443,7 +443,7 @@ def run_e2e_records(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def run_e2e(batch: int, epochs: int) -> dict:
+def run_e2e(batch: int, epochs: int, chain_steps: int = 1) -> dict:
     """End-to-end throughput: the FULL ``Trainer.train_epoch`` hot path —
     ShardedLoader -> native C++ crop/flip (uint8) -> ``device_prefetch`` ->
     on-device normalize -> jitted step — on materialized (synthetic-CIFAR)
@@ -451,7 +451,8 @@ def run_e2e(batch: int, epochs: int) -> dict:
     (``trainer/trainer.py:143-156``); the step microbench above excludes the
     input pipeline. Epoch 0 pays compiles and is discarded; the best
     remaining epoch is reported (interference on the shared relay chip only
-    subtracts)."""
+    subtracts). ``chain_steps > 1`` runs the trainer's chained-window mode
+    (windows of that many steps dispatch as one device program)."""
     import shutil
     import sys
     import tempfile
@@ -471,6 +472,7 @@ def run_e2e(batch: int, epochs: int) -> dict:
         save_folder=tmp,
         snapshot_path=None,
         progress=False,
+        chain_steps=chain_steps,
         # keep stdout to the ONE json line the driver parses
         logger=Logger("bench-e2e", os.path.join(tmp, "log.log")),
     )
@@ -478,6 +480,28 @@ def run_e2e(batch: int, epochs: int) -> dict:
         return _time_epochs(trainer, epochs, batch)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _time_windows(run_once, state, steps, windows, reduce):
+    """The one window-timing protocol every measurement uses: warm once, then
+    ``windows`` timed windows separated by ``BENCH_WINDOW_GAP_S`` (the shared
+    chip's slow phases last tens of seconds; spacing windows samples past
+    them), each synced via a scalar device_get (``block_until_ready`` alone
+    can be a no-op on relay-backed platforms). ``run_once(state) -> (state,
+    metrics)`` runs one window of ``steps`` steps. Returns the carried state
+    and the best (or ``reduce="median"``: median) per-step seconds."""
+    state, m = run_once(state)
+    _ = float(m["loss"])
+    per_step = []
+    for w in range(windows):
+        if w:
+            time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
+        t0 = time.perf_counter()
+        state, m = run_once(state)
+        _ = float(m["loss"])
+        per_step.append((time.perf_counter() - t0) / steps)
+    dt = float(np.median(per_step)) if reduce == "median" else min(per_step)
+    return state, dt
 
 
 def main():
@@ -550,21 +574,9 @@ def main():
     # Warmup, then best of `windows` timed windows (the shared relay chip's
     # interference only ever subtracts; BENCH_REDUCE=median reports the
     # median instead — measured ~5% below best-of, the spread being relay
-    # noise, not step variance: chained windows pin the device loop). Sync
-    # via a scalar device_get — block_until_ready alone can be a no-op on
-    # relay-backed platforms.
-    state, m = run_window(state)
-    _ = float(m["loss"])
-    per_step = []
-    for w in range(windows):
-        if w:
-            time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
-        t0 = time.perf_counter()
-        state, metrics = run_window(state)
-        _ = float(metrics["loss"])
-        per_step.append((time.perf_counter() - t0) / steps)
+    # noise, not step variance: chained windows pin the device loop).
     reduce = os.environ.get("BENCH_REDUCE", "min")
-    dt = float(np.median(per_step)) if reduce == "median" else min(per_step)
+    state, dt = _time_windows(run_window, state, steps, windows, reduce)
 
     # Executed-flops recount from the compiled program — BEFORE the e2e
     # block below may delete the executable (see the mfu comment further
@@ -572,6 +584,32 @@ def main():
     from distributed_training_pytorch_tpu.utils.hlo_flops import executed_matmul_flops
 
     exec_step_flops = executed_matmul_flops(compiled if chain else probe)
+
+    # Host dispatch gap (ISSUE 2 satellite): per-step wall time when every
+    # step is dispatched from Python — the regime a Trainer WITHOUT
+    # chain_steps pays — minus the chained executable's per-step time
+    # (device-resident window). The difference is pure host/dispatch
+    # overhead: what Trainer(chain_steps=N) removes from train_epoch. The
+    # dispatch loop syncs once per window (like the chained loop), not per
+    # step, so the gap measures dispatch latency, not added host syncs.
+    # BENCH_DISPATCH_GAP=0 skips the extra single-step compile.
+    dispatch = {}
+    if chain and os.environ.get("BENCH_DISPATCH_GAP", "1") != "0":
+        step_probe = engine.compile_train_step(state, gbatch, compiler_options=opts)
+
+        def run_dispatch(st):
+            for _ in range(steps):
+                st, pm = step_probe(st, gbatch)
+            return st, pm
+
+        state, dt_dispatch = _time_windows(
+            run_dispatch, state, steps, min(3, windows), reduce
+        )
+        dispatch = {
+            "step_ms_dispatch": round(dt_dispatch * 1e3, 2),
+            "dispatch_gap_ms": round((dt_dispatch - dt) * 1e3, 2),
+        }
+        del step_probe
 
     # ViT remat-cliff guard (r4 VERDICT item 6): config 4's 50.8% MFU rests
     # on batch 192 sitting on the good side of XLA's backward-remat threshold
@@ -604,19 +642,8 @@ def main():
         probe_exec = engine.compile_chained_train_steps(
             state, probe_gbatch, steps, compiler_options=opts
         )
-        st, pm = probe_exec(state, probe_gbatch)  # warm
-        _ = float(pm["loss"])
-        probe_windows = min(3, windows)
-        probe_per_step = []
-        for w in range(probe_windows):
-            if w:
-                time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
-            t0 = time.perf_counter()
-            st, pm = probe_exec(st, probe_gbatch)
-            _ = float(pm["loss"])
-            probe_per_step.append((time.perf_counter() - t0) / steps)
-        probe_dt = (
-            float(np.median(probe_per_step)) if reduce == "median" else min(probe_per_step)
+        st, probe_dt = _time_windows(
+            lambda s: probe_exec(s, probe_gbatch), state, steps, min(3, windows), reduce
         )
         del st, probe_exec, probe_gbatch
         per_img_main = dt / batch
@@ -642,8 +669,17 @@ def main():
     # BENCH_E2E=1: also run the input-pipeline-fed epoch loop and report it
     # next to the device-step number (VERDICT r2 item 2; r3 item 5 extends
     # it beyond vgg16 to the records path of configs 3-5).
+    # BENCH_TRAINER_LOOP=1 (vgg16): the trainer-loop chained mode — the SAME
+    # Trainer.train_epoch path with chain_steps=BENCH_CHAIN_STEPS, measuring
+    # whether real training closes the dispatch gap the chained microbench
+    # predicts (acceptance: trainer_vs_step within ~5% of 1.0).
     e2e = {}
-    if os.environ.get("BENCH_E2E") == "1":
+    trainer_loop = {}
+    want_e2e = os.environ.get("BENCH_E2E") == "1"
+    want_trainer_loop = (
+        os.environ.get("BENCH_TRAINER_LOOP") == "1" and model_name == "vgg16"
+    )
+    if want_e2e or want_trainer_loop:
         # Free the microbench's device state first: its TrainState + batch +
         # executable would otherwise coexist with the e2e trainer's own
         # (ConvNeXt-L: 2 x ~2.4 GB optimizer states + batch-512 workspaces
@@ -657,7 +693,8 @@ def main():
         import gc
 
         gc.collect()
-        e2e_epochs = int(os.environ.get("BENCH_E2E_EPOCHS", "3"))
+    e2e_epochs = int(os.environ.get("BENCH_E2E_EPOCHS", "3"))
+    if want_e2e:
         if model_name == "vgg16":
             e2e = run_e2e(batch, epochs=e2e_epochs)
         elif model_name in ("resnet50", "convnext_l", "vit"):
@@ -672,6 +709,17 @@ def main():
             e2e["e2e_vs_step"] = round(
                 e2e["e2e_images_per_sec"] / (batch * cfg["items_per_row"](image_size) / dt), 4
             )
+    if want_trainer_loop:
+        # Default 10: must divide the Trainer's log_every default (50) —
+        # chained syncs land on window boundaries (ctor-validated).
+        chain_steps = int(os.environ.get("BENCH_CHAIN_STEPS", "10"))
+        tl = run_e2e(batch, epochs=e2e_epochs, chain_steps=chain_steps)
+        trainer_step_ms = batch / tl["e2e_images_per_sec"] * 1e3
+        trainer_loop = {
+            "trainer_chain_steps": chain_steps,
+            "trainer_step_ms": round(trainer_step_ms, 2),
+            "trainer_vs_step": round(trainer_step_ms / (dt * 1e3), 4),
+        }
 
     n_chips = len(jax.devices())
     items = batch * cfg["items_per_row"](image_size)
@@ -754,8 +802,10 @@ def main():
                 ),
                 "batch": batch,
                 "step_ms": round(dt * 1e3, 2),
+                **dispatch,
                 **cliff_probe,
                 **e2e,
+                **trainer_loop,
             }
         )
     )

@@ -13,7 +13,10 @@ from distributed_training_pytorch_tpu.data.records import (  # noqa: F401
     pack_image_folder,
     write_shards,
 )
-from distributed_training_pytorch_tpu.data.prefetch import device_prefetch  # noqa: F401
+from distributed_training_pytorch_tpu.data.prefetch import (  # noqa: F401
+    device_prefetch,
+    device_prefetch_chained,
+)
 from distributed_training_pytorch_tpu.data.transforms import (  # noqa: F401
     IMAGENET_MEAN,
     IMAGENET_STD,

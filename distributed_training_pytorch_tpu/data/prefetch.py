@@ -5,26 +5,44 @@ The reference's per-step H2D copy is synchronous inside ``train_step``
 SURVEY.md §2e). Here transfers are issued from a background thread ``depth``
 batches ahead: ``jax.make_array_from_process_local_data`` starts the async
 H2D copy and XLA's scheduler overlaps it with the running step.
+
+Two staging modes share the same producer/consumer machinery:
+
+* :func:`device_prefetch` — one global batch per item (the single-step loop);
+* :func:`device_prefetch_chained` — chain-major: ``chain_steps`` consecutive
+  global batches stacked on a new leading axis and shipped as ONE device
+  array per window (``parallel.mesh.chain_batch_sharding`` layout), feeding
+  the engine's chained train step. Still ``depth`` *windows* in flight, so
+  on-device staging memory is bounded by ``depth x chain_steps`` batches.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from typing import Iterable, Iterator
 
 import jax
+import numpy as np
 
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 
 
-def device_prefetch(
-    batches: Iterable[dict],
-    mesh: jax.sharding.Mesh,
-    *,
-    depth: int = 2,
-) -> Iterator[dict]:
-    """Yield global data-sharded ``jax.Array`` batches, ``depth`` in flight."""
+def _prefetched(items: Iterable, depth: int) -> Iterator:
+    """Drive ``items`` from a background thread, ``depth`` results in flight.
+
+    Shutdown contract (both normal exhaustion and an abandoned consumer): the
+    producer's ``put`` aborts once ``cancelled`` is set, and the consumer's
+    cleanup must release every device buffer parked in the queue. The drain
+    below runs *after* signalling ``cancelled``, pulls with ``get_nowait``
+    until ``Empty`` (``q.empty()`` is only a snapshot — a producer blocked in
+    ``q.put`` can land one more item right after a non-empty check), and
+    re-drains once more after ``join``: the producer may have completed a
+    final ``put`` between the first drain and its own ``cancelled`` check, and
+    a buffer stranded that way would keep ``depth`` device batches live for
+    the queue object's lifetime.
+    """
     q: queue.Queue = queue.Queue(maxsize=depth)
     _SENTINEL = object()
     err: list[BaseException] = []
@@ -32,8 +50,7 @@ def device_prefetch(
 
     def producer():
         try:
-            for host_batch in batches:
-                item = mesh_lib.global_array_from_host_local(host_batch, mesh)
+            for item in items:
                 # Bounded put that aborts when the consumer goes away, so an
                 # abandoned iterator can't leave this thread (and `depth`
                 # device batches) parked on a full queue forever.
@@ -55,6 +72,7 @@ def device_prefetch(
                 except queue.Full:
                     if cancelled.is_set():
                         break
+
     thread = threading.Thread(target=producer, daemon=True, name="device-prefetch")
     thread.start()
     try:
@@ -67,9 +85,76 @@ def device_prefetch(
             yield item
     finally:
         cancelled.set()
-        while not q.empty():  # release device buffers held by the queue
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
+
+        def drain():
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    return
+
+        drain()
         thread.join(timeout=2.0)
+        drain()  # a put completed before the producer observed `cancelled`
+
+
+def device_prefetch(
+    batches: Iterable[dict],
+    mesh: jax.sharding.Mesh,
+    *,
+    depth: int = 2,
+) -> Iterator[dict]:
+    """Yield global data-sharded ``jax.Array`` batches, ``depth`` in flight."""
+    staged = (
+        mesh_lib.global_array_from_host_local(host_batch, mesh)
+        for host_batch in batches
+    )
+    return _prefetched(staged, depth)
+
+
+def device_prefetch_chained(
+    batches: Iterable[dict],
+    mesh: jax.sharding.Mesh,
+    chain_steps: int,
+    *,
+    depth: int = 2,
+    lead_singles: int = 0,
+) -> Iterator[tuple[int, dict]]:
+    """Chain-major device staging: yield ``(n, batch)`` execution units.
+
+    ``n == chain_steps``: ``batch`` is a window of ``chain_steps`` consecutive
+    global batches stacked on a new leading axis (one
+    ``chain_batch_sharding``-laid-out transfer), ready for
+    ``TrainEngine.train_steps_chained``. ``n == 1``: ``batch`` is a plain
+    single-step global batch — emitted for the first ``lead_singles`` batches
+    (the trainer's window-boundary realignment after a mid-epoch resume, and
+    its profiled first-epoch prefix) and for the epoch tail shorter than a
+    full window (compiling a fresh chain per tail length would cost a
+    full-model retrace; the tail reuses the already-compiled single step).
+
+    ``chain_steps == 1`` degenerates to :func:`device_prefetch` semantics
+    (every unit a single), so one consumer loop serves both modes.
+    """
+    if chain_steps < 1:
+        raise ValueError(f"chain_steps must be >= 1, got {chain_steps}")
+
+    def staged():
+        it = iter(batches)
+        for host_batch in itertools.islice(it, max(0, int(lead_singles))):
+            yield 1, mesh_lib.global_array_from_host_local(host_batch, mesh)
+        while True:
+            window = list(itertools.islice(it, chain_steps))
+            if not window:
+                return
+            if len(window) < chain_steps or chain_steps == 1:
+                for host_batch in window:
+                    yield 1, mesh_lib.global_array_from_host_local(host_batch, mesh)
+                if len(window) < chain_steps:
+                    return
+                continue
+            stacked = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *window
+            )
+            yield chain_steps, mesh_lib.global_chain_array_from_host_local(stacked, mesh)
+
+    return _prefetched(staged(), depth)

@@ -112,6 +112,27 @@ class FaultPlan:
     def count_fired(self, kind: str) -> int:
         return sum(1 for k, _ in self.fired if k == kind)
 
+    # Step-loop injection kinds — the ones Trainer.train_epoch queries per
+    # step. (checkpoint/record kinds fire in other components and don't
+    # constrain the step loop's execution shape.)
+    STEP_KINDS = ("sigterm", "hang", "nan_loss")
+
+    def active_in_window(self, epoch: int, start: int, stop: int) -> bool:
+        """True when any step-loop event with budget left COULD fire at some
+        step in ``[start, stop)`` of ``epoch``. Non-consuming — this is the
+        trainer's pre-dispatch query deciding whether a chained window must
+        fall back to single-step execution so the per-step injection points
+        actually run (a whole-window device program has no per-step host
+        hook to inject at)."""
+        for ev in self.events:
+            if ev.kind not in self.STEP_KINDS or ev.count <= 0:
+                continue
+            if ev.epoch is not None and ev.epoch != epoch:
+                continue
+            if ev.step is None or start <= ev.step < stop:
+                return True
+        return False
+
     # -- injection-point helpers ------------------------------------------
 
     def maybe_raise(self, kind: str, **ctx) -> None:

@@ -167,6 +167,19 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def chain_batch_sharding(mesh: Mesh, batch_axes: Sequence[str] | None = None) -> NamedSharding:
+    """Sharding for a chain-stacked batch ``[chain, batch, ...]``: the leading
+    (step/time) axis stays unsharded — every device sees every step of the
+    window — while the second (batch) axis splits over the data-like mesh axes
+    exactly as :func:`batch_sharding` does. This is the input layout of the
+    engine's chained train step (``TrainEngine.train_steps_chained``), whose
+    ``lax.scan`` slices one per-step batch off the leading axis per trip."""
+    if batch_axes is None:
+        batch_axes = [a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names]
+    spec = P(None, tuple(batch_axes)) if batch_axes else P()
+    return NamedSharding(mesh, spec)
+
+
 def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
     """Per-host batch size — global-batch semantics of ``trainer/trainer.py:56``
     (``batch_size // world_size``), except the divisor is host count because
@@ -185,6 +198,20 @@ def global_array_from_host_local(batch, mesh: Mesh) -> jax.Array:
     out across the mesh without any cross-host copy.
     """
     sharding = batch_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+def global_chain_array_from_host_local(batch, mesh: Mesh) -> jax.Array:
+    """Chain-major twin of :func:`global_array_from_host_local`: every leaf is
+    ``[chain, local_batch, ...]`` (this host's rows of ``chain`` consecutive
+    global batches stacked on a new leading axis) and assembles into one global
+    ``[chain, global_batch, ...]`` array laid out per
+    :func:`chain_batch_sharding` — one H2D staging call per window instead of
+    one per step."""
+    sharding = chain_batch_sharding(mesh)
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
         batch,

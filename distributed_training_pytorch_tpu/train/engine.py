@@ -19,6 +19,7 @@ microbatches inside the same compiled step.
 
 from __future__ import annotations
 
+from collections import Counter
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
@@ -111,6 +112,17 @@ class TrainEngine:
         self._state_structure = None
         self._train_step = None
         self._eval_step = None
+        # Chained executables, one per window length (jit itself caches per
+        # input shape, so a given length never retraces for the same batch
+        # shapes). Tail windows shorter than the chain length are the
+        # trainer's job to run single-step — compiling a fresh chain per tail
+        # length would pay a full-model compile for one window.
+        self._chained_fns: dict[int, Any] = {}
+        # Compilation counters: bumped once per TRACE of each compiled body
+        # (a jit cache hit does not re-execute the Python body). The
+        # scripts/retrace_guard.py CI gate asserts these stay at 1 per shape,
+        # so a dispatch-path change that silently retraces fails fast.
+        self.trace_counts: Counter = Counter()
 
     def state_sharding(self, state_or_abstract) -> Any:
         """The NamedSharding tree this engine lays state out with.
@@ -152,14 +164,23 @@ class TrainEngine:
         if self._train_step is not None:
             return
         state_sharding = self.state_sharding(state)
+
+        def train_step(state, batch):
+            self.trace_counts["train_step"] += 1
+            return self._train_step_impl(state, batch)
+
+        def eval_step(state, batch):
+            self.trace_counts["eval_step"] += 1
+            return self._eval_step_impl(state, batch)
+
         self._train_step = jax.jit(
-            self._train_step_impl,
+            train_step,
             in_shardings=(state_sharding, self._batch_sharding),
             out_shardings=(state_sharding, self._replicated),
             donate_argnums=self._donate,
         )
         self._eval_step = jax.jit(
-            self._eval_step_impl,
+            eval_step,
             in_shardings=(state_sharding, self._batch_sharding),
             out_shardings=self._replicated,
         )
@@ -308,6 +329,71 @@ class TrainEngine:
         """Host-local rows -> one global data-sharded array (see
         ``parallel.mesh.global_array_from_host_local``)."""
         return mesh_lib.global_array_from_host_local(batch, self.mesh)
+
+    def train_steps_chained(self, state: TrainState, stacked_batch, length: int):
+        """Run ``length`` train steps as ONE compiled on-device program.
+
+        ``stacked_batch`` leaves carry a leading step axis of size ``length``
+        (``parallel.mesh.chain_batch_sharding`` layout — the
+        ``data.device_prefetch_chained`` staging format): a ``lax.scan``
+        carries the state and slices one per-step batch per trip, so a single
+        dispatch executes the whole window back-to-back on device. Per-step
+        RNG still advances via ``state.step``, and the nan-guard and
+        microbatch-accumulation paths run inside the scan body unchanged —
+        chained execution is bit-identical to ``length`` sequential
+        :meth:`train_step` calls on the same data (test-enforced).
+
+        Returns ``(state, metrics)`` where every metric leaf has leading axis
+        ``length`` — per-step values as scan outputs, so callers keep exact
+        per-step accounting (loss logging, ``nonfinite`` counts) without any
+        extra host sync.
+
+        Executables are cached per ``length`` (and per shape, by jit): call
+        with ONE window length and route shorter tails to :meth:`train_step`
+        instead of paying a fresh full-model compile per tail length.
+        """
+        if length < 1:
+            raise ValueError(f"length must be >= 1, got {length}")
+        self._build_steps(state)
+        fn = self._chained_fns.get(length)
+        if fn is None:
+            state_sharding = self.state_sharding(state)
+            chain_sharding = mesh_lib.chain_batch_sharding(self.mesh)
+
+            def chained(st, sbatch):
+                self.trace_counts[f"chained_{length}"] += 1
+                # _train_step_impl(state, batch) -> (state, metrics) is
+                # exactly scan's (carry, x) -> (carry, y) contract; ys stack
+                # into the per-step metrics. unroll=length: a rolled While
+                # body reads its per-step batch through a dynamic-slice whose
+                # layout can differ from the standalone step's input, and the
+                # conv wgrad reduction order shifts by 1 ULP with it
+                # (measured on CPU: 1 element of a VGG conv kernel after 4
+                # steps) — unrolled windows reproduce the single-step program
+                # bit-for-bit. Cost: compile time linear in `length`, the
+                # right trade at the 4-32 window sizes chaining targets.
+                return jax.lax.scan(
+                    self._train_step_impl, st, sbatch, unroll=length
+                )
+
+            fn = jax.jit(
+                chained,
+                in_shardings=(state_sharding, chain_sharding),
+                out_shardings=(state_sharding, self._replicated),
+                donate_argnums=self._donate,
+            )
+            self._chained_fns[length] = fn
+        with self._ambient_mesh():
+            return fn(state, stacked_batch)
+
+    def unstack_window(self, stacked_batch, index: int):
+        """Slice step ``index``'s batch out of a chain-stacked window, laid
+        out as the single-step batch sharding — the trainer's fallback when a
+        staged window must run step-by-step after all (fault injection
+        active in its range)."""
+        return jax.tree.map(
+            lambda x: jax.device_put(x[index], self._batch_sharding), stacked_batch
+        )
 
     def compile_train_step(self, state: TrainState, batch, *, compiler_options=None):
         """AOT-compile the train step for these shapes and return the compiled

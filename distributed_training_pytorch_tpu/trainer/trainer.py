@@ -59,7 +59,11 @@ from distributed_training_pytorch_tpu.checkpoint import (
     CheckpointManager,
     epoch_checkpoint_name,
 )
-from distributed_training_pytorch_tpu.data import ShardedLoader, device_prefetch
+from distributed_training_pytorch_tpu.data import (
+    ShardedLoader,
+    device_prefetch,
+    device_prefetch_chained,
+)
 from distributed_training_pytorch_tpu.fault.watchdog import StepWatchdog
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.train import (
@@ -96,6 +100,7 @@ class Trainer:
         num_workers: int = 8,
         prefetch_batches: int = 2,
         log_every: int = 50,
+        chain_steps: int = 1,
         last_save_period: int = 1,
         async_checkpoint: bool = True,
         profile_dir: str | None = None,
@@ -188,8 +193,22 @@ class Trainer:
         # a completed step, SIGTERM ourselves — the preemption handler then
         # turns the hang into a resumable save at the next safe point.
         self.step_timeout = step_timeout
+        # The timeout actually armed (step_timeout x chain_steps under
+        # chaining — set by train_epoch, reported by _on_hung_step).
+        self._watchdog_timeout = step_timeout
         # Deterministic fault injection (tests; None in production).
         self.fault_plan = fault_plan
+        # On-device chained execution (perf): windows of `chain_steps` train
+        # steps dispatch as ONE compiled program (engine.train_steps_chained),
+        # eliminating per-step host dispatch from the hot loop — the regime
+        # the bench's chained mode measures, now in real training. Per-step
+        # metrics come back as scan outputs so loss logging and nonfinite
+        # accounting stay exact; the epoch tail, the resume-realignment
+        # prefix, the profiled first-epoch prefix, and any window with
+        # pending fault injections automatically fall back to single-step
+        # execution (bit-exact either way — test-enforced).
+        self.chain_steps = int(chain_steps)
+        self._validate_chain_config()
         # Mid-epoch resume position (set when restoring a preemption save's
         # loop state; consumed by the first trained epoch).
         self._resume_step_in_epoch = 0
@@ -422,24 +441,117 @@ class Trainer:
         self.checkpoints.wait()
         self.log("Finished!")
 
+    def _validate_chain_config(self) -> None:
+        """Reject/round knob combinations that would silently misalign with
+        chained-window execution — fail loudly at construction, not as a
+        drifted log cadence or a preemption poll that never fires."""
+        if self.chain_steps < 1:
+            raise ValueError(f"chain_steps must be >= 1, got {self.chain_steps}")
+        if self.chain_steps == 1:
+            return
+        if type(self).train_step is not Trainer.train_step:
+            raise ValueError(
+                "chain_steps > 1 requires the engine-backed default train_step: "
+                f"{type(self).__name__} overrides train_step, which executes "
+                "per-step Python the chained device program cannot call. Keep "
+                "chain_steps=1, or move the customization into build_loss_fn "
+                "(traced into the compiled step, chains fine)."
+            )
+        if self.log_every and self.log_every % self.chain_steps:
+            raise ValueError(
+                f"log_every ({self.log_every}) must be a multiple of "
+                f"chain_steps ({self.chain_steps}): intra-epoch loss syncs "
+                "happen at window boundaries, so a non-multiple would silently "
+                "drift the log cadence. Round log_every or chain_steps."
+            )
+        if self.preemption_check_every and self.preemption_check_every % self.chain_steps:
+            rounded = (
+                -(-self.preemption_check_every // self.chain_steps) * self.chain_steps
+            )
+            self.log(
+                f"preemption_check_every={self.preemption_check_every} is not a "
+                f"multiple of chain_steps={self.chain_steps} — rounded up to "
+                f"{rounded} so multi-host preemption votes land on window "
+                "boundaries (they cannot fire mid-window).",
+                "warning",
+            )
+            self.preemption_check_every = rounded
+        if self.step_timeout:
+            self.log(
+                f"chain_steps={self.chain_steps}: the hung-step watchdog pats "
+                f"once per window, so its effective timeout scales to "
+                f"step_timeout x chain_steps = {self.step_timeout * self.chain_steps}s."
+            )
+
+    def _chain_lead_singles(self, skip_steps: int) -> int:
+        """Single steps to run before the first chained window of an epoch:
+        realigns a mid-epoch resume offset to a window boundary (windows sit
+        at absolute step_in_epoch multiples of chain_steps, so chained and
+        resumed runs execute identical window shapes), and keeps the profiled
+        prefix of the first epoch on the per-step path (the profiler brackets
+        individual steps; its stop check fires at step 1 + profile_steps)."""
+        first_window_step = skip_steps
+        # skip_steps <= 1: _maybe_profile only ever STARTS a trace at
+        # step_in_epoch == 1, so a deeper mid-epoch resume cannot profile
+        # this epoch — extending its single-step prefix would waste
+        # dispatches without a trace to show for it.
+        if self.profile_dir is not None and not self._profiled and skip_steps <= 1:
+            first_window_step = max(first_window_step, 2 + self.profile_steps)
+        aligned = -(-first_window_step // self.chain_steps) * self.chain_steps
+        return aligned - skip_steps
+
+    def _fault_active_in_window(self, epoch: int, start: int, stop: int) -> bool:
+        return self.fault_plan is not None and self.fault_plan.active_in_window(
+            epoch, start, stop
+        )
+
+    def _pat_watchdog(self, watchdog, timeout):
+        """Arm (first completed step only — the first step includes XLA
+        compilation, minutes for a real model: arming before it would SIGTERM
+        mid-compile and the resumed run would recompile and die the same way,
+        a restart livelock) and pat the hung-step watchdog."""
+        if not timeout:
+            return watchdog
+        if watchdog is None:
+            # max_fires=2: fire 1 = graceful SIGTERM save; fire 2 = the
+            # thread is wedged, hard-exit (_on_hung_step).
+            watchdog = StepWatchdog(timeout, self._on_hung_step, max_fires=2).start()
+        watchdog.pat()
+        return watchdog
+
     def train_epoch(self, epoch: int) -> dict:
-        """Inner hot loop: compiled step per global batch, device-resident
-        metrics (no per-step host sync — the reference pays a ``loss.item()``
-        sync every step, ``example_trainer.py:89``).
+        """Inner hot loop: compiled step per global batch — or, with
+        ``chain_steps > 1``, ONE compiled program per window of chain_steps
+        batches (``engine.train_steps_chained``), removing per-step host
+        dispatch entirely. Metrics stay device-resident either way (no
+        per-step host sync — the reference pays a ``loss.item()`` sync every
+        step, ``example_trainer.py:89``); chained windows return per-step
+        metrics as scan outputs, so the accounting below is identical.
 
         Mid-epoch resume: when this epoch was interrupted by a preemption
         save at step k, the first k batches are skipped (the loader's
         permutation and the per-(epoch, index) augmentation keys are
         deterministic, so the surviving stream is identical to the one the
         interrupted run would have seen) — the resumed run stays bit-exact
-        with an uninterrupted one."""
-        collected: list[Any] = []
+        with an uninterrupted one. Under chaining the first (-k mod
+        chain_steps) resumed steps run single-step so window boundaries
+        realign to the uninterrupted run's."""
+        # Metric records: (k, tree) where k == 1 holds one step's scalar
+        # metrics and k > 1 a whole window's stacked scan outputs. Kept
+        # UNsliced on purpose: per-step slicing here would issue k x num_keys
+        # tiny device ops right after the one chained dispatch — paying back
+        # the very dispatch overhead chaining removes. Slicing happens where
+        # a host sync exists anyway (log points, epoch end).
+        collected: list[tuple[int, Any]] = []
         skip_steps = self._resume_step_in_epoch
         self._resume_step_in_epoch = 0  # consumed by the first trained epoch
         step_in_epoch = skip_steps
         executed = 0
-        synced = 0  # index into `collected` of the last nan-policy sync
+        synced_entries = 0  # index into `collected` of the last nan-policy sync
+        synced_steps = 0  # the same sync position, in steps
         t0 = time.perf_counter()
+        num_batches = len(self.train_dataloader)
+        chain = self.chain_steps
         # Resume skip happens at the loader's INDEX level when it can
         # (iter_batches: none of the skipped batches are read or decoded);
         # generic iterables fall back to drain-and-discard.
@@ -454,67 +566,125 @@ class Trainer:
         host_batches = (
             self._check_image_range(self.preprocess_batch(b)) for b in source_iter
         )
-        batches = device_prefetch(host_batches, self.mesh)
-        bar = self._progress_bar(len(self.train_dataloader), f"epoch {epoch + 1}")
+        # Execution units (n, batch): n == chain -> a chain-stacked window,
+        # n == 1 -> a plain single-step batch (lead realignment + epoch tail).
+        if chain > 1:
+            units = device_prefetch_chained(
+                host_batches,
+                self.mesh,
+                chain,
+                lead_singles=self._chain_lead_singles(skip_steps),
+            )
+        else:
+            units = ((1, b) for b in device_prefetch(host_batches, self.mesh))
+        bar = self._progress_bar(num_batches, f"epoch {epoch + 1}")
         self._epoch_interrupted = False
-        # Armed only after the FIRST completed step of the epoch: the first
-        # step includes XLA compilation (minutes for a real model) — arming
-        # before it would SIGTERM mid-compile, and the resumed run would
-        # recompile and die the same way: a restart livelock.
         watchdog = None
-        try:
-            for batch in batches:
-                if self.fault_plan is not None:
-                    batch = self._inject_step_faults(batch, epoch, step_in_epoch)
-                if self._preemption_requested(step_in_epoch):
-                    self._preempted = True  # collective decision (multi-host OR)
-                    self._epoch_interrupted = True
-                    self._interrupted_at_step = step_in_epoch
-                    break
-                self._maybe_profile(step_in_epoch)
-                self.state, metrics = self.train_step(self.state, batch)
-                collected.append(metrics)
-                step_in_epoch += 1
-                executed += 1
-                if self.step_timeout:
-                    if watchdog is None:
-                        # max_fires=2: fire 1 = graceful SIGTERM save; fire 2
-                        # = the thread is wedged, hard-exit (_on_hung_step).
-                        watchdog = StepWatchdog(
-                            self.step_timeout, self._on_hung_step, max_fires=2
-                        ).start()
-                    watchdog.pat()
-                if bar is not None:
-                    # Advancing the bar is host-only; the postfix refreshes at the
-                    # log_every sync points (a true per-step live loss would force
-                    # the reference's per-step loss.item() sync back in).
-                    bar.update(1)
-                if self.log_every and step_in_epoch % self.log_every == 0:
-                    # Intra-epoch host syncs: this (every log_every steps) and,
-                    # multi-host only, the preemption vote (_preemption_requested).
-                    m = {k: float(v) for k, v in collected[-1].items()}
-                    if "nonfinite" in m:
-                        # The policy check must see every step since the last
-                        # sync, not just the latest — a guarded poison at step
-                        # k<now has nonfinite=1 only in ITS metrics.
-                        m_check = dict(m)
-                        m_check["nonfinite"] = float(
-                            np.sum([float(x["nonfinite"]) for x in collected[synced:]])
-                        )
-                        synced = len(collected)
-                        self._apply_nan_policy(m_check)
-                    else:
-                        self._apply_nan_policy(m)
-                    rate = executed * self.batch_size / (time.perf_counter() - t0)
-                    if bar is not None:
-                        bar.set_postfix(m, refresh=False)
-                        bar.clear()  # keep log lines off the live bar row
-                    self.log(
-                        f"  step {step_in_epoch}/{len(self.train_dataloader)} "
-                        f"{m} ({rate:.1f} img/s)"
+        # The watchdog pats once per executed unit; under chaining a window
+        # legitimately takes ~chain step-times, so the timeout scales with it
+        # (single-step fallback units then just run with extra slack).
+        watchdog_timeout = self.step_timeout * chain if self.step_timeout else None
+        self._watchdog_timeout = watchdog_timeout
+
+        def sync_log_point():
+            # Intra-epoch host syncs: this (every log_every steps — always a
+            # window boundary, log_every % chain_steps == 0 is ctor-enforced)
+            # and, multi-host only, the preemption vote (_preemption_requested).
+            nonlocal synced_entries, synced_steps
+            n_last, last = collected[-1]
+            m = {
+                k: float(v[-1]) if n_last > 1 else float(v) for k, v in last.items()
+            }
+            if "nonfinite" in m:
+                # The policy check must see every step since the last sync,
+                # not just the latest — a guarded poison at step k<now has
+                # nonfinite=1 only in ITS metrics. Chained windows report
+                # per-step nonfinite flags (scan outputs), so the sum below
+                # counts poisoned steps exactly as the single-step loop does.
+                m_check = dict(m)
+                m_check["nonfinite"] = float(
+                    np.sum(
+                        [
+                            np.sum(np.asarray(x["nonfinite"]))
+                            for _, x in collected[synced_entries:]
+                        ]
                     )
+                )
+                synced_entries = len(collected)
+                synced_steps = executed
+                self._apply_nan_policy(m_check)
+            else:
+                self._apply_nan_policy(m)
+            rate = executed * self.batch_size / (time.perf_counter() - t0)
+            if bar is not None:
+                bar.set_postfix(m, refresh=False)
+                bar.clear()  # keep log lines off the live bar row
+            self.log(f"  step {step_in_epoch}/{num_batches} {m} ({rate:.1f} img/s)")
+            if bar is not None:
+                bar.refresh()
+
+        try:
+            interrupted = False
+            for n, batch in units:
+                if n > 1 and not self._fault_active_in_window(
+                    epoch, step_in_epoch, step_in_epoch + n
+                ):
+                    # -- chained window: one dispatch runs n steps on device.
+                    # Preemption is polled at window boundaries only (the
+                    # device program has no mid-window host hook), so saves
+                    # land on boundaries and the watchdog/vote cadences above
+                    # are scaled/rounded to match.
+                    if self._preemption_requested(step_in_epoch):
+                        self._preempted = True  # collective (multi-host OR)
+                        interrupted = True
+                        break
+                    self.state, window_metrics = self.engine.train_steps_chained(
+                        self.state, batch, n
+                    )
+                    collected.append((n, window_metrics))
+                    step_in_epoch += n
+                    executed += n
+                    watchdog = self._pat_watchdog(watchdog, watchdog_timeout)
                     if bar is not None:
-                        bar.refresh()
+                        bar.update(n)
+                    if self.log_every and step_in_epoch % self.log_every == 0:
+                        sync_log_point()
+                    continue
+                # -- single-step path: lead/tail units, chain_steps == 1, and
+                # windows with pending fault injections (unstacked so the
+                # per-step injection points and preemption checks actually
+                # run — semantics identical to the unchained loop).
+                singles = (
+                    (batch,)
+                    if n == 1
+                    else (self.engine.unstack_window(batch, i) for i in range(n))
+                )
+                for b in singles:
+                    if self.fault_plan is not None:
+                        b = self._inject_step_faults(b, epoch, step_in_epoch)
+                    if self._preemption_requested(step_in_epoch):
+                        self._preempted = True  # collective (multi-host OR)
+                        interrupted = True
+                        break
+                    self._maybe_profile(step_in_epoch)
+                    self.state, metrics = self.train_step(self.state, b)
+                    collected.append((1, metrics))
+                    step_in_epoch += 1
+                    executed += 1
+                    watchdog = self._pat_watchdog(watchdog, watchdog_timeout)
+                    if bar is not None:
+                        # Advancing the bar is host-only; the postfix refreshes
+                        # at the log_every sync points (a true per-step live
+                        # loss would force the reference's per-step
+                        # loss.item() sync back in).
+                        bar.update(1)
+                    if self.log_every and step_in_epoch % self.log_every == 0:
+                        sync_log_point()
+                if interrupted:
+                    break
+            if interrupted:
+                self._epoch_interrupted = True
+                self._interrupted_at_step = step_in_epoch
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -523,8 +693,17 @@ class Trainer:
             bar.close()
         if not collected:
             return {}
-        host = jax.device_get(collected)
-        return self._aggregate_epoch_metrics(host, synced)
+        # ONE host transfer for the whole epoch, then expand window records
+        # to per-step dicts host-side (free: numpy indexing, no device ops).
+        host: list[dict] = []
+        for k, tree in jax.device_get(collected):
+            if k == 1:
+                host.append(tree)
+            else:
+                host.extend(
+                    {key: v[i] for key, v in tree.items()} for i in range(k)
+                )
+        return self._aggregate_epoch_metrics(host, synced_steps)
 
     def _aggregate_epoch_metrics(self, host: list[dict], synced: int = 0) -> dict:
         """Per-epoch means. Under the non-finite guard, poisoned steps are
@@ -619,9 +798,10 @@ class Trainer:
         # with EX_TEMPFAIL so the scheduler restarts from the last
         # checkpoint. That IS the bounded loss; the alternative is a silent
         # stall until the job-level timeout.
+        timeout = self._watchdog_timeout or self.step_timeout
         if self._hung_once:
             self.log(
-                f"watchdog: still no progress {self.step_timeout}s after "
+                f"watchdog: still no progress {timeout}s after "
                 "SIGTERM — main thread is wedged; hard-exiting for scheduler "
                 "restart (resume from the last checkpoint)",
                 "error",
@@ -629,7 +809,7 @@ class Trainer:
             os._exit(75)  # EX_TEMPFAIL
         self._hung_once = True
         self.log(
-            f"watchdog: no step completed in {self.step_timeout}s — forcing a "
+            f"watchdog: no step completed in {timeout}s — forcing a "
             "preemption-style resumable save",
             "warning",
         )

@@ -158,6 +158,7 @@ if __name__ == "__main__":
         base_lr=float(os.environ.get("BASE_LR", "0.1")),
         max_epoch=int(os.environ.get("EPOCHS", "100")),
         batch_size=int(os.environ.get("BATCH", "1024")),
+        chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
         have_validate=True,
         save_best_for=("accuracy", "geq"),
         save_period=5,

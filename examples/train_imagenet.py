@@ -222,6 +222,7 @@ if __name__ == "__main__":
         base_lr=float(os.environ.get("BASE_LR", str(recipe["base_lr"]))),
         max_epoch=int(os.environ.get("EPOCHS", "90")),
         batch_size=int(os.environ.get("BATCH", "1024")),
+        chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
         accum_steps=int(os.environ.get("ACCUM", str(recipe["accum"]))),
         have_validate=True,
         save_best_for=("accuracy", "geq"),

@@ -150,6 +150,7 @@ if __name__ == "__main__":
         moe_every=int(os.environ.get("MOE_EVERY", "0")),
         max_epoch=int(os.environ.get("EPOCHS", "10")),
         batch_size=int(os.environ.get("BATCH", "256")),
+        chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
         have_validate=True,
         save_best_for=("nll", "leq"),
         save_period=int(os.environ.get("SAVE_PERIOD", "1")),

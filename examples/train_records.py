@@ -138,6 +138,7 @@ if __name__ == "__main__":
         base_lr=float(os.environ.get("RECORDS_LR", "0.1")),
         max_epoch=int(os.environ.get("EPOCHS", "60")),
         batch_size=int(os.environ.get("BATCH", "128")),
+        chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
         have_validate=True,
         save_best_for=("accuracy", "geq"),
         save_period=int(os.environ.get("SAVE_PERIOD", "10")),

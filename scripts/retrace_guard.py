@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Retrace guard — chained-dispatch-path CI gate (ISSUE 2 satellite).
+
+Runs a tiny CPU training job through the REAL ``Trainer.train_epoch`` hot
+path with ``chain_steps=4`` (windows + epoch-tail singles, two epochs so
+every executable is re-dispatched) and asserts, via the engine's compilation
+counters (``TrainEngine.trace_counts``, bumped once per jit TRACE), that:
+
+* the chained window executable compiled exactly ONCE for its (length,
+  shapes) — a second trace means something in the dispatch path (sharding
+  drift, shape drift, cache-key churn) silently retraces every window, which
+  on a real model turns each window into a multi-minute compile;
+* the single-step executable (epoch tails) also compiled exactly once;
+* no unexpected chain lengths were compiled (a tail must fall back to the
+  single step, not compile a fresh chain per tail length).
+
+Fails fast (nonzero exit) so ``scripts/verify.sh`` catches dispatch-path
+regressions before the full test suite runs.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.data import ArrayDataSource
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.trainer import Trainer
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(3)(x)
+
+
+class GuardTrainer(Trainer):
+    def build_train_dataset(self):
+        rng = np.random.RandomState(0)
+        labels = rng.randint(0, 3, size=(48,)).astype(np.int32)
+        images = (rng.randn(48, 4, 4, 3) + labels[:, None, None, None]).astype(
+            np.float32
+        )
+        return ArrayDataSource(image=images, label=labels)
+
+    def build_model(self):
+        return TinyNet()
+
+    def build_criterion(self):
+        def criterion(logits, batch):
+            loss = cross_entropy_loss(logits, batch["label"])
+            return loss, {"loss": loss}
+
+        return criterion
+
+    def build_optimizer(self, schedule):
+        return optax.sgd(schedule)
+
+    def build_scheduler(self):
+        return 0.05
+
+
+def main() -> int:
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="retrace_guard_")
+    try:
+        trainer = GuardTrainer(
+            max_epoch=2,  # epoch 2 re-dispatches every executable: cache must hit
+            batch_size=8,  # 48 records -> 6 steps/epoch: one window + 2-step tail
+            save_folder=tmp,
+            chain_steps=4,
+            num_workers=0,
+            log_every=0,
+            async_checkpoint=False,
+            progress=False,
+            logger=type("Q", (), {"log": staticmethod(lambda *a, **k: None)})(),
+        )
+        trainer.train()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    counts = dict(trainer.engine.trace_counts)
+    expected = {"chained_4": 1, "train_step": 1}
+    errors = []
+    for key, want in expected.items():
+        got = counts.get(key, 0)
+        if got != want:
+            errors.append(f"{key}: traced {got}x, expected {want}x")
+    stray = [k for k in counts if k.startswith("chained_") and k not in expected]
+    if stray:
+        errors.append(
+            f"unexpected chain lengths compiled: {stray} (epoch tails must "
+            "reuse the single step, not compile per-tail chains)"
+        )
+    if errors:
+        print(f"RETRACE GUARD FAILED — trace counts {counts}:", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(f"retrace guard OK: {counts} (chained executable compiled once per shape)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,12 +7,18 @@
 # buried in a multi-minute test run — exactly how the seed's 14 import
 # breakages went unnoticed.
 #
-# Stage 2 is the ROADMAP.md tier-1 command verbatim.
+# Stage 2 is a ~8s CPU run through the real chained Trainer hot path
+# asserting (via the engine's compilation counters) that the chained
+# executable compiles exactly once per shape — a dispatch-path regression
+# that silently retraces every window fails here in seconds instead of as a
+# mysterious multi-minute-per-window slowdown on real hardware.
+#
+# Stage 3 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/2: import health (pytest --collect-only) =="
+echo "== stage 1/3: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -21,7 +27,13 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/2: tier-1 test suite =="
+echo "== stage 2/3: chained-dispatch retrace guard =="
+if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
+  echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
+  exit 3
+fi
+
+echo "== stage 3/3: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

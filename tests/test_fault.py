@@ -398,6 +398,57 @@ def test_sigterm_mid_epoch_resume_is_bit_exact(tmp_path, mesh):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_sigterm_resume_crosses_window_boundary_chained(tmp_path, mesh):
+    """Chained-mode preemption acceptance (ISSUE 2): a chain_steps=4 run is
+    killed by an injected (real) SIGTERM at epoch 1, step 2 — inside the
+    fault-active window [0,4), which therefore runs single-step, preserving
+    exact per-step interruption semantics. The resume then REALIGNS: steps
+    2-3 run single-step until the next window boundary, and [4,8) chains —
+    finishing bit-exact with an uninterrupted chain_steps=1 run."""
+    kw = dict(
+        max_epoch=2, batch_size=8, have_validate=False, save_best_for=None,
+        save_period=None,
+    )
+    baseline = make_trainer(tmp_path / "a", mesh, **kw)
+    baseline.train()
+
+    plan = FaultPlan().add("sigterm", epoch=1, step=2)
+    interrupted = make_trainer(
+        tmp_path / "b", mesh, chain_steps=4, fault_plan=plan, **kw
+    )
+    interrupted.train()
+    assert interrupted._preempted and interrupted._epoch_interrupted
+    assert interrupted.checkpoints.exists(LAST)
+    meta = interrupted.checkpoints.read_meta(LAST)
+    assert meta["epoch"] == 1 and meta["loop"] == {"step_in_epoch": 2}
+    # epoch 0 had no pending injections: it really chained (2 windows of 4)
+    assert interrupted.engine.trace_counts["chained_4"] == 1
+
+    resumed = make_trainer(
+        tmp_path / "b",
+        mesh,
+        chain_steps=4,
+        snapshot_path=interrupted.checkpoints.path(LAST),
+        **kw,
+    )
+    assert resumed.cur_epoch == 1 and resumed._resume_step_in_epoch == 2
+    resumed.train()
+
+    assert int(resumed.state.step) == int(baseline.state.step)
+    for a, b in zip(
+        jax.tree.leaves(baseline.state.params), jax.tree.leaves(resumed.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(baseline.state.opt_state),
+        jax.tree.leaves(resumed.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # realignment shape: 2 lead singles (steps 2-3), then ONE chained window
+    assert resumed.engine.trace_counts["train_step"] == 1
+    assert resumed.engine.trace_counts["chained_4"] == 1
+
+
 def test_nan_policy_raise(tmp_path, mesh):
     plan = FaultPlan().add("nan_loss", epoch=0, step=1)
     trainer = make_trainer(

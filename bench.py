@@ -146,12 +146,59 @@ def lm_train_flops_per_token(model, seq_len: int) -> float:
     return 6.0 * p_matmul + 12.0 * L * seq_len * dm
 
 
-def _build_vgg16(num_classes, image_size):
+# BENCH_DTYPE (ISSUE 3 satellite): compute dtype of the benched step —
+# fp32 | bf16 | fp16, or a comma list ("fp32,bf16,fp16") for a sweep that
+# prints ONE json line per dtype. Unset reproduces the historical program
+# exactly: model-internal bf16 casts, no precision policy in the engine.
+# When set, the model is built with that dtype AND the engine applies the
+# matching precision.Policy (fp16 adds dynamic loss scaling), so the timed
+# step is the one Trainer(precision=...) runs.
+BENCH_DTYPES = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+def _bench_dtype(dtype_name):
+    """Model dtype for a BENCH_DTYPE value (None = historical bf16 default)."""
+    if dtype_name is None:
+        return jnp.bfloat16
+    if dtype_name not in BENCH_DTYPES:
+        raise SystemExit(
+            f"unknown BENCH_DTYPE {dtype_name!r} (choose from {sorted(BENCH_DTYPES)})"
+        )
+    return BENCH_DTYPES[dtype_name]
+
+
+def _bench_memory(compiled, include_peak=True):
+    """Per-step device memory: live/peak bytes from the PJRT allocator where
+    the backend exposes them (``memory_stats`` — TPU does, after the timed
+    windows so peak covers the real step), else XLA's ``bytes accessed``
+    estimate from the compiled program (CPU smoke runs).
+
+    ``include_peak=False`` for every sweep run after the first:
+    ``peak_bytes_in_use`` is a process-lifetime high-water mark with no
+    reset, so a later (smaller) dtype's peak would silently report the
+    earlier run's — live_bytes stays valid per-run."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except (AttributeError, NotImplementedError, RuntimeError):
+        stats = None
+    if stats:
+        out = {}
+        if "bytes_in_use" in stats:
+            out["live_bytes"] = int(stats["bytes_in_use"])
+        if "peak_bytes_in_use" in stats and include_peak:
+            out["peak_bytes"] = int(stats["peak_bytes_in_use"])
+        if out:
+            return out
+    ba = hlo_flops.bytes_accessed(compiled)
+    return {"hlo_bytes_accessed": int(ba)} if ba else {}
+
+
+def _build_vgg16(num_classes, image_size, dtype):
     del image_size
-    return VGG16(num_classes=num_classes, dtype=jnp.bfloat16)
+    return VGG16(num_classes=num_classes, dtype=dtype)
 
 
-def _build_vit(num_classes, image_size):
+def _build_vit(num_classes, image_size, dtype):
     del image_size
     from distributed_training_pytorch_tpu.models import ViTB16
 
@@ -163,18 +210,18 @@ def _build_vit(num_classes, image_size):
     # ViT-B's T=197 onto the 128-lane MXU exactly (models/vit.py pad_seq_to).
     pad_seq = int(os.environ.get("BENCH_PAD_SEQ", "0")) or None
     return ViTB16(
-        num_classes=num_classes, dtype=jnp.bfloat16, use_flash=use_flash,
+        num_classes=num_classes, dtype=dtype, use_flash=use_flash,
         pad_seq_to=pad_seq,
     )
 
 
-def _build_lm(num_classes, image_size):
+def _build_lm(num_classes, image_size, dtype):
     from distributed_training_pytorch_tpu.models import GPTSmall
 
     del num_classes  # byte/GPT-2 vocab is part of the model config
     # image_size = sequence length here; long-context runs stretch max_len
     # with it (the flash kernel auto-routes at T>=512).
-    return GPTSmall(dtype=jnp.bfloat16, max_len=max(1024, image_size))
+    return GPTSmall(dtype=dtype, max_len=max(1024, image_size))
 
 
 def _image_batch(rng, batch, size, num_classes, model):
@@ -253,10 +300,10 @@ BENCH_MODELS = {
         # isolation, but the full step measures SLOWER (fusion-barrier cost;
         # BASELINE.md "ResNet-50" r5 section) — the flag exists to reproduce
         # that measurement, not as a perf default.
-        "build": lambda n, size: __import__(
+        "build": lambda n, size, dtype: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ResNet50"]
         ).ResNet50(
-            num_classes=n, dtype=jnp.bfloat16,
+            num_classes=n, dtype=dtype,
             pallas_1x1=os.environ.get("BENCH_PALLAS_1X1", "0") == "1",
         ),
         "flops": resnet_train_flops_per_image,
@@ -266,9 +313,9 @@ BENCH_MODELS = {
         "metric": "images/sec/chip (ResNet-50, ImageNet-shape, bf16)",
     },
     "convnext_l": {
-        "build": lambda n, size: __import__(
+        "build": lambda n, size, dtype: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ConvNeXtL"]
-        ).ConvNeXtL(num_classes=n, dtype=jnp.bfloat16),
+        ).ConvNeXtL(num_classes=n, dtype=dtype),
         "flops": convnext_train_flops_per_image,
         # r4 sweep: plain-step img/s rises monotonically to microbatch 128
         # (402@32, 441@64, 452@96, 475@128) and cliffs at 192 (405), so the
@@ -314,12 +361,15 @@ for _name, _cfg in BENCH_MODELS.items():
     )
 
 
-def build_bench_setup(model_name: str | None = None):
+def build_bench_setup(model_name: str | None = None, dtype_name: str | None = None):
     """One source of truth for the executable a ``BENCH_MODEL`` names: build
     the registry model + engine + AOT state + sharded batch + per-model
     compiler options from the same env knobs ``main()`` honors. Used by
     ``main()`` and ``scripts/profile_step.py`` so the profiled program IS the
-    timed one."""
+    timed one.
+
+    ``dtype_name`` is ONE ``BENCH_DTYPE`` value (callers handle the sweep);
+    None = the historical program (bf16 model casts, no engine policy)."""
     model_name = model_name or os.environ.get("BENCH_MODEL", "vgg16")
     if model_name not in BENCH_MODELS:
         raise SystemExit(
@@ -332,12 +382,19 @@ def build_bench_setup(model_name: str | None = None):
     # takes it from the returned dict so the knob cannot drift.
     accum_steps = int(os.environ.get("BENCH_ACCUM", str(cfg.get("accum_steps", 1))))
     mesh = mesh_lib.create_mesh()
-    model = cfg["build"](cfg["num_classes"], image_size)
+    model = cfg["build"](cfg["num_classes"], image_size, _bench_dtype(dtype_name))
+    loss_scale = None
+    if dtype_name == "fp16":
+        from distributed_training_pytorch_tpu.precision import DynamicScale
+
+        loss_scale = DynamicScale.create()
     engine = TrainEngine(
         cfg["make_loss"](model),
         optax.sgd(0.01, momentum=0.9),
         mesh,
         accum_steps=accum_steps,
+        precision=dtype_name,  # None -> inactive fp32 policy (historical)
+        loss_scale=loss_scale,
     )
     state = engine.init_state(
         jax.random.key(0),
@@ -357,6 +414,7 @@ def build_bench_setup(model_name: str | None = None):
         "state": state,
         "gbatch": gbatch,
         "accum_steps": accum_steps,
+        "dtype_name": dtype_name,
         "compiler_options": cfg["compiler_options"]() or None,
     }
 
@@ -504,9 +562,9 @@ def _time_windows(run_once, state, steps, windows, reduce):
     return state, dt
 
 
-def main():
+def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     enable_fast_rng()
-    setup = build_bench_setup()
+    setup = build_bench_setup(dtype_name=dtype_name)
     model_name, cfg = setup["model_name"], setup["cfg"]
     batch, image_size = setup["batch"], setup["image_size"]
     model, engine, state, gbatch = (
@@ -584,6 +642,13 @@ def main():
     from distributed_training_pytorch_tpu.utils.hlo_flops import executed_matmul_flops
 
     exec_step_flops = executed_matmul_flops(compiled if chain else probe)
+    # Per-step device memory + roofline position (ISSUE 3 satellite): read
+    # while the timed executable is alive and AFTER the timed windows, so an
+    # allocator peak covers the real step's live set. Arithmetic intensity
+    # uses XLA's own executed flops over its bytes-accessed estimate — the
+    # pair the bf16/fp32 sweep moves together (docs/performance.md roofline).
+    memory = _bench_memory(compiled if chain else probe, include_peak=include_peak)
+    arith_intensity = hlo_flops.arithmetic_intensity(compiled if chain else probe)
 
     # Host dispatch gap (ISSUE 2 satellite): per-step wall time when every
     # step is dispatched from Python — the regime a Trainer WITHOUT
@@ -772,7 +837,11 @@ def main():
     print(
         json.dumps(
             {
-                "metric": cfg["metric"].format(size=image_size),
+                # metric strings name the historical bf16 dtype; a BENCH_DTYPE
+                # override renames them so sweep lines are self-describing.
+                "metric": cfg["metric"]
+                .format(size=image_size)
+                .replace("bf16", setup["dtype_name"] or "bf16"),
                 "value": round(images_per_sec / n_chips, 2),
                 "unit": cfg["unit"],
                 "vs_baseline": round(mfu / 0.60, 4),
@@ -802,6 +871,15 @@ def main():
                 ),
                 "batch": batch,
                 "step_ms": round(dt * 1e3, 2),
+                # Compute dtype of the benched step: explicit BENCH_DTYPE, or
+                # the historical model-internal-bf16 program when unset.
+                "dtype": setup["dtype_name"] or "bf16",
+                **memory,
+                **(
+                    {"arith_intensity": round(arith_intensity, 2)}
+                    if arith_intensity
+                    else {}
+                ),
                 **dispatch,
                 **cliff_probe,
                 **e2e,
@@ -809,6 +887,21 @@ def main():
             }
         )
     )
+
+
+def main():
+    # BENCH_DTYPE sweep: a comma list runs the whole measurement once per
+    # dtype (one json line each — BENCH_r06-style sweeps diff the lines);
+    # a single value (or unset) keeps the one-line contract. Every entry is
+    # validated BEFORE the first run — a typo in the last entry must fail in
+    # milliseconds, not after the earlier entries' multi-minute measurements.
+    sweep = [d.strip() for d in os.environ.get("BENCH_DTYPE", "").split(",") if d.strip()]
+    for dtype_name in sweep:
+        _bench_dtype(dtype_name)
+    for i, dtype_name in enumerate(sweep or [None]):
+        # peak_bytes only on the first run of the process: the allocator's
+        # peak is a lifetime high-water mark (see _bench_memory).
+        _run_bench(dtype_name, include_peak=(i == 0))
 
 
 if __name__ == "__main__":

@@ -16,6 +16,9 @@ offline evaluator — rebuilt TPU-first:
 * ``ops``       — losses, metrics, schedules, Pallas kernels.
 * ``train``     — functional ``TrainState`` + jitted train/eval step engine
   (replaces DDP + criterion/optimizer/scheduler mutation).
+* ``precision`` — mixed-precision dtype policies (fp32/bf16/fp16 with fp32
+  master weights) + dynamic loss scaling as on-device pytree state
+  (docs/mixed_precision.md).
 * ``data``      — deterministic host-sharded input pipeline with device prefetch
   (replaces ``DistributedSampler`` + ``DataLoader``).
 * ``checkpoint``— Orbax-backed best/last/periodic checkpointing with resume,
@@ -46,4 +49,9 @@ from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
     setup_distributed,
     create_mesh,
     shutdown_distributed,
+)
+from distributed_training_pytorch_tpu.precision import (  # noqa: F401
+    DynamicScale,
+    NoOpScale,
+    Policy,
 )

@@ -250,17 +250,31 @@ class CheckpointManager:
         else:
             rest["rng_data"] = state.rng
             meta["rng_impl"] = None
-        # Decomposed layout (params / opt_state / rest) — the analog of the
-        # reference saving model/optimizer/scheduler state dicts as separate
-        # keys (``trainer/trainer.py:85-92``); it also lets consumers that
-        # only need weights (offline eval) restore params alone even when
+        # Decomposed layout (params / opt_state / rest [/ scale]) — the analog
+        # of the reference saving model/optimizer/scheduler state dicts as
+        # separate keys (``trainer/trainer.py:85-92``); it also lets consumers
+        # that only need weights (offline eval) restore params alone even when
         # their optimizer differs from the training one.
-        args = ocp.args.Composite(
-            params=ocp.args.StandardSave(state.params),
-            opt_state=ocp.args.StandardSave(state.opt_state),
-            rest=ocp.args.StandardSave(rest),
-            meta=ocp.args.JsonSave(meta),
-        )
+        items = {
+            "params": ocp.args.StandardSave(state.params),
+            "opt_state": ocp.args.StandardSave(state.opt_state),
+            "rest": ocp.args.StandardSave(rest),
+        }
+        # Mixed-precision loss-scale state (precision.loss_scale) rides as its
+        # OWN composite item, present only when it has array leaves (a
+        # DynamicScale; None/NoOpScale states save the pre-precision layout
+        # verbatim) — so pre-precision checkpoints, fp32 checkpoints, and
+        # fp16 checkpoints all restore against any target: a missing item
+        # means "keep the target's fresh default scale".
+        scale_state = getattr(state, "loss_scale", None)
+        if jax.tree.leaves(scale_state):
+            from flax import serialization
+
+            items["scale"] = ocp.args.StandardSave(
+                serialization.to_state_dict(scale_state)
+            )
+            meta["loss_scale"] = type(scale_state).__name__
+        args = ocp.args.Composite(meta=ocp.args.JsonSave(meta), **items)
         staging = self._new_staging(name)
         try:
             self._attempt_save(staging, args, blocking=False)
@@ -532,6 +546,23 @@ class CheckpointManager:
             items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
         if not params_only and legacy:
             items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
+        # Loss-scale state: restored only when BOTH sides speak it — the
+        # checkpoint carries a `scale` item AND the target state has scale
+        # leaves to lay it into. A pre-precision (or fp32) checkpoint under
+        # a dynamic-scale target leaves the target's fresh default in place;
+        # a dynamic-scale checkpoint under an fp32 target drops the scale.
+        target_scale = getattr(target_state, "loss_scale", None)
+        restore_scale = (
+            not params_only
+            and bool(jax.tree.leaves(target_scale))
+            and os.path.isdir(os.path.join(path, "scale"))
+        )
+        if restore_scale:
+            from flax import serialization
+
+            items["scale"] = ocp.args.StandardRestore(
+                serialization.to_state_dict(abstract.loss_scale)
+            )
         restored = self._ckptr.restore(path, args=ocp.args.Composite(**items))
         meta = restored.meta or {}
         if meta.get("best_value") is not None:
@@ -546,6 +577,12 @@ class CheckpointManager:
                 opt_state=restored.opt_state,
                 step=restored.rest["step"],
                 rng=rng,
+            )
+        if restore_scale:
+            from flax import serialization
+
+            state = state.replace(
+                loss_scale=serialization.from_state_dict(target_scale, restored.scale)
             )
         return state, int(meta.get("epoch", 0))
 

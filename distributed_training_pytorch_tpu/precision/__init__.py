@@ -1,0 +1,22 @@
+"""Mixed-precision subsystem (ISSUE 3): dtype policies + loss scaling.
+
+``Policy`` names the (param, compute, output) dtype triple applied at the
+loss-fn boundary inside the compiled step (``precision.policy``);
+``NoOpScale``/``DynamicScale`` implement loss scaling as pytree state carried
+in ``TrainState`` (``precision.loss_scale``). Wire-up: ``Trainer(precision=
+"bf16")`` / ``TrainEngine(precision=..., loss_scale=...)``; see
+``docs/mixed_precision.md``.
+"""
+
+from distributed_training_pytorch_tpu.precision.policy import (  # noqa: F401
+    Policy,
+    compute_dtype,
+    get_policy,
+    model_dtype_for_entry,
+)
+from distributed_training_pytorch_tpu.precision.loss_scale import (  # noqa: F401
+    DynamicScale,
+    NoOpScale,
+    is_dynamic,
+    resolve_loss_scale,
+)

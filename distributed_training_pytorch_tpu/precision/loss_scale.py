@@ -1,0 +1,157 @@
+"""Loss scaling — fp16's gradient-underflow countermeasure, as pytree state.
+
+fp16 grads underflow to zero below ~6e-5; multiplying the loss by a large
+scale S shifts the whole gradient distribution up into representable range,
+and dividing the grads by S afterwards recovers the true values. Both scale
+states here live INSIDE :class:`~distributed_training_pytorch_tpu.train.state.
+TrainState` (``state.loss_scale``) so the entire grow/backoff/skip protocol
+runs in the compiled step with zero extra host syncs, survives
+crash-consistent checkpoint/resume (``checkpoint/manager.py`` serializes it
+as its own composite item), and rides through chained windows
+(``TrainEngine.train_steps_chained`` carries it in the scan state).
+
+* :class:`NoOpScale` — the identity protocol (bf16/fp32 runs that want the
+  scale-state plumbing without the arithmetic). Zero pytree leaves: a state
+  carrying it checkpoints identically to one carrying ``None``.
+* :class:`DynamicScale` — torch.amp.GradScaler's protocol: on a step with
+  non-finite grads the update is SKIPPED and the scale backs off by
+  ``backoff_factor``; after ``growth_interval`` consecutive finite steps it
+  grows by ``growth_factor``. All factors are powers of two by default, so
+  scaling/unscaling is exact in floating point.
+
+The skip itself is the engine's unified non-finite guard — the same
+conditional apply ``nan_policy="skip"`` uses — so an overflow-skip and a
+nan-skip are ONE event counted once (``metrics["nonfinite"]``), never twice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+__all__ = ["NoOpScale", "DynamicScale", "is_dynamic", "resolve_loss_scale"]
+
+
+@struct.dataclass
+class NoOpScale:
+    """Identity loss scale: no state (zero pytree leaves), no arithmetic."""
+
+    def scale_loss(self, loss: jax.Array) -> jax.Array:
+        return loss
+
+    def unscale_grads(self, grads):
+        return grads
+
+    def adjust(self, grads_finite: jax.Array) -> "NoOpScale":
+        del grads_finite
+        return self
+
+
+@struct.dataclass
+class DynamicScale:
+    """Dynamic loss scale state (one fp32 + two int32 scalars).
+
+    ``scale``/``growth_counter``/``skipped_steps`` are pytree leaves carried
+    in ``TrainState``; the protocol constants are static (part of the jit
+    cache key — changing them retraces, which is correct: they are baked
+    into the compiled update).
+
+    Build instances with :meth:`create` (canonicalizes the leaves to device
+    scalars); ``skipped_steps`` counts overflow-skips cumulatively for
+    observability (the Trainer emits it to TensorBoard).
+    """
+
+    scale: jax.Array
+    growth_counter: jax.Array
+    skipped_steps: jax.Array
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    min_scale: float = struct.field(pytree_node=False, default=1.0)
+    max_scale: float = struct.field(pytree_node=False, default=float(2.0**24))
+
+    @classmethod
+    def create(
+        cls,
+        initial_scale: float = 2.0**15,
+        *,
+        growth_interval: int = 2000,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        min_scale: float = 1.0,
+        max_scale: float = 2.0**24,
+    ) -> "DynamicScale":
+        """torch.amp defaults: init 2^15 (the largest power of two below
+        fp16's 65504 max — a bigger init would overflow the loss cotangent at
+        the output cast before the first backoff could react), x2 growth
+        every 2000 clean steps, /2 backoff on overflow."""
+        if initial_scale <= 0:
+            raise ValueError(f"initial_scale must be > 0, got {initial_scale}")
+        return cls(
+            scale=jnp.asarray(initial_scale, jnp.float32),
+            growth_counter=jnp.asarray(0, jnp.int32),
+            skipped_steps=jnp.asarray(0, jnp.int32),
+            growth_interval=int(growth_interval),
+            growth_factor=float(growth_factor),
+            backoff_factor=float(backoff_factor),
+            min_scale=float(min_scale),
+            max_scale=float(max_scale),
+        )
+
+    def scale_loss(self, loss: jax.Array) -> jax.Array:
+        return loss * self.scale.astype(loss.dtype)
+
+    def unscale_grads(self, grads):
+        inv = 1.0 / self.scale  # powers of two: the reciprocal is exact
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+
+    def adjust(self, grads_finite: jax.Array) -> "DynamicScale":
+        """One protocol step, fully on device: grow after ``growth_interval``
+        consecutive finite steps, back off (and count the skip) on overflow."""
+        finite = grads_finite.astype(jnp.bool_)
+        counter = self.growth_counter + 1
+        grow = finite & (counter >= self.growth_interval)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(
+                grow,
+                jnp.minimum(self.scale * self.growth_factor, self.max_scale),
+                self.scale,
+            ),
+            jnp.maximum(self.scale * self.backoff_factor, self.min_scale),
+        )
+        new_counter = jnp.where(grow | ~finite, 0, counter).astype(jnp.int32)
+        new_skipped = self.skipped_steps + jnp.where(finite, 0, 1).astype(jnp.int32)
+        return self.replace(
+            scale=new_scale, growth_counter=new_counter, skipped_steps=new_skipped
+        )
+
+
+def is_dynamic(scale_state) -> bool:
+    """Static (trace-time) test the engine branches on: only a DynamicScale
+    carries scale arithmetic and the grow/backoff update into the step."""
+    return isinstance(scale_state, DynamicScale)
+
+
+def resolve_loss_scale(spec, policy):
+    """Trainer-knob resolution: ``None`` = auto (dynamic iff the policy
+    computes in fp16), ``"dynamic"``/``"none"`` by name, or an instance."""
+    if spec is None:
+        if policy.compute_dtype == jnp.float16:
+            return DynamicScale.create()
+        return None
+    if isinstance(spec, str):
+        key = spec.lower()
+        if key == "dynamic":
+            return DynamicScale.create()
+        if key in ("none", "noop", "no_op"):
+            return NoOpScale()
+        raise ValueError(
+            f"unknown loss_scale {spec!r} (use 'dynamic', 'none', None, or an instance)"
+        )
+    if isinstance(spec, (NoOpScale, DynamicScale)):
+        return spec
+    raise TypeError(
+        f"loss_scale must be a str, NoOpScale, DynamicScale, or None, got {type(spec)}"
+    )

@@ -1,0 +1,132 @@
+"""Mixed-precision dtype policies (ISSUE 3 tentpole).
+
+The reference trains fp32-everywhere (SURVEY.md §0 "no mixed precision"); on
+TPU the MXU's native dtype is bf16, so fp32-everywhere leaves the largest
+single-knob perf/memory win unused. A :class:`Policy` names the three dtypes
+of the standard mixed-precision recipe (the jmp / t5x convention):
+
+* ``param_dtype``   — what the master weights and optimizer state are stored
+  in. Always fp32 in the named presets: the optimizer update happens in full
+  precision, so bf16/fp16 rounding never accumulates across steps.
+* ``compute_dtype`` — what the forward/backward matmuls run in. The engine
+  casts params and float inputs to this dtype at the loss-fn boundary INSIDE
+  the compiled step; gradients flow back through the cast and arrive in
+  ``param_dtype`` (the cast's transpose accumulates), so the grads/optimizer
+  path never sees the low-precision dtype.
+* ``output_dtype``  — what the loss is cast to before (scaled) ``jax.grad``
+  sees it; fp32 so loss-scale arithmetic and metric accumulation are exact.
+
+The ``"fp32"`` preset is the identity policy: the engine detects it
+statically and traces the exact pre-precision program (bit-exactness with
+unpoliced runs is test-enforced, ``tests/test_precision.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Policy", "get_policy", "compute_dtype", "model_dtype_for_entry"]
+
+
+def _cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast floating leaves to ``dtype``; integer/bool leaves (labels, uint8
+    images awaiting on-device normalize) pass through untouched."""
+
+    def cast(x):
+        if jnp.issubdtype(getattr(x, "dtype", jnp.int32), jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """``(param_dtype, compute_dtype, output_dtype)`` — see module docstring.
+
+    Hashable and static: the engine branches on :attr:`active` at trace time,
+    so the fp32 preset contributes zero ops to the compiled step.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+    name: str = "fp32"
+
+    @property
+    def active(self) -> bool:
+        """True when this policy inserts any cast at the loss-fn boundary."""
+        return not (
+            self.param_dtype == self.compute_dtype == self.output_dtype == jnp.float32
+        )
+
+    def cast_params(self, params: Any) -> Any:
+        """Master (``param_dtype``) weights -> ``compute_dtype`` activations'
+        view, applied once at the loss-fn boundary. Grads of the uncast params
+        come back in ``param_dtype`` through the cast's transpose."""
+        return _cast_floating(params, self.compute_dtype)
+
+    def cast_inputs(self, batch: Any) -> Any:
+        """Float batch leaves -> ``compute_dtype`` (ints/uint8 untouched)."""
+        return _cast_floating(batch, self.compute_dtype)
+
+    def cast_output(self, loss: jax.Array) -> jax.Array:
+        return loss.astype(self.output_dtype)
+
+
+# Named presets. fp16 REQUIRES loss scaling (its ~6e-5..65504 dynamic range
+# underflows small gradients without it) — the Trainer ctor enforces that.
+_PRESETS = {
+    "fp32": Policy(jnp.float32, jnp.float32, jnp.float32, name="fp32"),
+    "bf16": Policy(jnp.float32, jnp.bfloat16, jnp.float32, name="bf16"),
+    "fp16": Policy(jnp.float32, jnp.float16, jnp.float32, name="fp16"),
+}
+_ALIASES = {"float32": "fp32", "bfloat16": "bf16", "float16": "fp16", "half": "fp16"}
+
+
+def get_policy(spec: "str | Policy | None") -> Policy:
+    """``None`` | preset name | :class:`Policy` -> :class:`Policy`."""
+    if spec is None:
+        return _PRESETS["fp32"]
+    if isinstance(spec, Policy):
+        return spec
+    if isinstance(spec, str):
+        key = _ALIASES.get(spec.lower(), spec.lower())
+        if key in _PRESETS:
+            return _PRESETS[key]
+        raise ValueError(
+            f"unknown precision {spec!r} (choose from {sorted(_PRESETS)} or pass a Policy)"
+        )
+    raise TypeError(f"precision must be a str, Policy, or None, got {type(spec)}")
+
+
+def compute_dtype(spec: "str | Policy | None") -> Any:
+    """The compute dtype a precision spec names — the dtype to build models
+    with (``models/*`` all take ``dtype=``) so model-internal casts agree
+    with the policy's boundary casts."""
+    return get_policy(spec).compute_dtype
+
+
+def model_dtype_for_entry(policy, explicit: bool, legacy_dtype=None) -> Any:
+    """Model dtype for an example entry with a ``DTYPE`` env knob — ONE
+    resolution rule shared by every entry (a per-entry copy once let an
+    explicit ``Trainer(precision=...)`` override disagree with the env).
+
+    The trainer's RESOLVED policy wins whenever it is active (bf16/fp16 —
+    however it was set, env knob or explicit ctor arg), so the model's
+    internal casts always match the engine's boundary casts. Under the
+    inactive fp32 policy, ``explicit`` says whether ANYONE asked for fp32
+    (env knob set or ``precision=`` passed — ``trainer.precision_requested``)
+    — then the model is float32; a fully unset knob keeps ``legacy_dtype``,
+    the entry's historical program (bf16 model-internal casts for the
+    throughput entries)."""
+    policy = get_policy(policy)
+    if policy.active:
+        return policy.compute_dtype
+    if explicit:
+        return jnp.float32
+    return legacy_dtype if legacy_dtype is not None else jnp.float32

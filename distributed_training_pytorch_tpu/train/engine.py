@@ -15,6 +15,17 @@ blocks per step.
 
 Gradient accumulation (BASELINE config 5) runs as a ``lax.scan`` over
 microbatches inside the same compiled step.
+
+Mixed precision (ISSUE 3): a ``precision.Policy`` casts params and float
+inputs to its compute dtype at the loss-fn boundary INSIDE the compiled step
+— master weights, grads, and optimizer state stay in ``param_dtype`` (fp32)
+because the grads of the uncast params flow back through the cast's
+transpose. Loss scaling (``precision.loss_scale``) rides in
+``state.loss_scale``: the loss is multiplied by the scale before ``grad``,
+grads divided after, and a ``DynamicScale`` folds torch.amp's grow/backoff/
+skip protocol into the same non-finite guard ``nan_guard`` uses, so an
+overflow-skip and a nan-skip are one event counted once. The default fp32
+policy is detected statically and traces the exact pre-precision program.
 """
 
 from __future__ import annotations
@@ -30,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel import sharding as sharding_lib
+from distributed_training_pytorch_tpu.precision import get_policy, is_dynamic
 from distributed_training_pytorch_tpu.train.state import TrainState
 
 # A LossFn maps (params, model_state, batch, rng, train) ->
@@ -87,12 +99,19 @@ class TrainEngine:
         sharding_rules: Sequence | None = None,
         fsdp_min_size: int = 2**18,
         nan_guard: bool = False,
+        precision=None,
+        loss_scale=None,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.accum_steps = int(accum_steps)
         self.schedule = schedule
+        # Mixed precision: the policy is static (trace-time) config; the
+        # loss-scale STATE lives in TrainState (init_state seeds it with this
+        # initial value) so it survives checkpoint/resume and chained scans.
+        self.precision = get_policy(precision)
+        self.initial_loss_scale = loss_scale
         # Non-finite step guard (graceful-degradation support): when on, a
         # step whose loss or grads contain NaN/Inf leaves params/opt_state/
         # model_state UNTOUCHED (step and rng still advance, so the data and
@@ -209,6 +228,7 @@ class TrainEngine:
                 opt_state=self.optimizer.init(params),
                 model_state=dict(variables),
                 rng=state_rng,
+                loss_scale=self.initial_loss_scale,
             )
 
         # Shape-infer the state, derive its sharding tree, then materialize
@@ -221,12 +241,49 @@ class TrainEngine:
 
     # -- compiled bodies --------------------------------------------------
 
+    def _wrap_loss(self, scale_state):
+        """The loss-fn boundary where mixed precision happens: cast params +
+        float inputs to the policy's compute dtype, cast the loss back to
+        fp32, and multiply by the loss scale so ``grad`` differentiates the
+        SCALED loss. Aux carries the raw (unscaled, fp32) loss for metrics.
+
+        With the fp32 policy and no dynamic scale this is a pure aux
+        restructure — zero ops added, the compiled program is bit-identical
+        to the pre-precision engine (test-enforced)."""
+        policy = self.precision
+        base = self.loss_fn
+        dynamic = is_dynamic(scale_state)
+        if not policy.active and not dynamic:
+            def wrapped(params, model_state, batch, rng, train):
+                loss, (metrics, new_ms) = base(params, model_state, batch, rng, train)
+                return loss, (loss, metrics, new_ms)
+
+            return wrapped
+
+        def wrapped(params, model_state, batch, rng, train):
+            loss, (metrics, new_ms) = base(
+                policy.cast_params(params),
+                model_state,
+                policy.cast_inputs(batch),
+                rng,
+                train,
+            )
+            loss = policy.cast_output(loss)
+            grad_loss = scale_state.scale_loss(loss) if dynamic else loss
+            return grad_loss, (loss, metrics, new_ms)
+
+        return wrapped
+
     def _grads_and_metrics(self, state: TrainState, batch, rng):
-        grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+        scale_state = state.loss_scale
+        dynamic = is_dynamic(scale_state)
+        grad_fn = jax.value_and_grad(self._wrap_loss(scale_state), has_aux=True)
         if self.accum_steps <= 1:
-            (loss, (metrics, new_ms)), grads = grad_fn(
+            (_, (loss, metrics, new_ms)), grads = grad_fn(
                 state.params, state.model_state, batch, rng, True
             )
+            if dynamic:
+                grads = scale_state.unscale_grads(grads)
             return grads, loss, metrics, new_ms
 
         # Microbatch scan: reshape [B, ...] -> [A, B/A, ...] and accumulate.
@@ -239,7 +296,7 @@ class TrainEngine:
             mb, micro_idx = xs
             grads_acc, loss_acc, metrics_acc, ms = carry
             mb_rng = jax.random.fold_in(rng, micro_idx)
-            (loss, (metrics, ms)), grads = grad_fn(state.params, ms, mb, mb_rng, True)
+            (_, (loss, metrics, ms)), grads = grad_fn(state.params, ms, mb, mb_rng, True)
             grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
             loss_acc = loss_acc + loss
             metrics_acc = jax.tree.map(jnp.add, metrics_acc, dict(metrics))
@@ -259,6 +316,8 @@ class TrainEngine:
             (zero_grads, jnp.zeros(()), zero_metrics, state.model_state),
             (micro, jnp.arange(self.accum_steps)),
         )
+        if dynamic:
+            grads = scale_state.unscale_grads(grads)  # accumulated scaled
         inv = 1.0 / self.accum_steps
         grads = jax.tree.map(lambda g: g * inv, grads)
         metrics = jax.tree.map(lambda m: m * inv, metrics)
@@ -270,7 +329,13 @@ class TrainEngine:
         updates, new_opt_state = self.optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
-        if self.nan_guard:
+        scale_state = state.loss_scale
+        dynamic = is_dynamic(scale_state)
+        if self.nan_guard or dynamic:
+            # ONE unified guard: a dynamic-scale overflow and a nan_policy
+            # poison are the same predicate, the same conditional apply, and
+            # the same metrics["nonfinite"] flag — a step is counted skipped
+            # once, never twice.
             ok = jnp.isfinite(loss)
             for g in jax.tree.leaves(grads):
                 ok &= jnp.all(jnp.isfinite(g))
@@ -281,11 +346,18 @@ class TrainEngine:
             new_opt_state = keep(new_opt_state, state.opt_state)
             new_ms = keep(new_ms, state.model_state)
             metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
+            if dynamic:
+                # Grow/backoff runs inside the step; the scale THIS step used
+                # is the observable metric (the post-adjust value is next
+                # step's metric).
+                scale_state = scale_state.adjust(ok)
+                metrics["loss_scale"] = state.loss_scale.scale
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             opt_state=new_opt_state,
             model_state=new_ms,
+            loss_scale=scale_state,
         )
         metrics.setdefault("loss", loss)
         if self.schedule is not None:
@@ -294,8 +366,11 @@ class TrainEngine:
 
     def _eval_step_impl(self, state: TrainState, batch):
         # Eval is deterministic (no dropout); the rng is passed only to keep
-        # the LossFn signature uniform.
-        _, (metrics, _) = self.loss_fn(state.params, state.model_state, batch, state.rng, False)
+        # the LossFn signature uniform. The precision policy's boundary casts
+        # apply to eval too (scale never does: no grads to protect).
+        _, (_, metrics, _) = self._wrap_loss(None)(
+            state.params, state.model_state, batch, state.rng, False
+        )
         return dict(metrics)
 
     # -- public API -------------------------------------------------------

@@ -30,6 +30,12 @@ class TrainState:
       ``batch_stats`` for ResNet); empty dict for stateless models.
     * ``rng``         — PRNG key for dropout/augmentation; folded with ``step``
       each call so resume is deterministic.
+    * ``loss_scale``  — mixed-precision loss-scale state (``precision.
+      loss_scale``): ``None`` (default — no scaling, zero leaves, identical
+      pytree behavior to the pre-precision layout), a ``NoOpScale`` (also
+      zero leaves), or a ``DynamicScale`` whose scale/counter/skip scalars
+      ride the state through the compiled step, chained windows, and
+      checkpoint save/resume.
     """
 
     step: jax.Array
@@ -37,6 +43,7 @@ class TrainState:
     opt_state: Any
     model_state: Any
     rng: jax.Array
+    loss_scale: Any = None
 
     def variables(self) -> dict:
         return {"params": self.params, **self.model_state}

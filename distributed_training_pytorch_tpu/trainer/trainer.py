@@ -66,6 +66,11 @@ from distributed_training_pytorch_tpu.data import (
 )
 from distributed_training_pytorch_tpu.fault.watchdog import StepWatchdog
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.precision import (
+    get_policy,
+    is_dynamic,
+    resolve_loss_scale,
+)
 from distributed_training_pytorch_tpu.train import (
     NonFiniteLossError,
     TrainEngine,
@@ -114,6 +119,8 @@ class Trainer:
         skip_corrupt_records: bool = False,
         step_timeout: float | None = None,
         fault_plan=None,
+        precision=None,
+        loss_scale=None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -188,6 +195,44 @@ class Trainer:
         self.nan_policy = nan_policy
         self.nonfinite_steps = 0
         self.nonfinite_rollbacks = 0
+        # Mixed precision (precision/ subsystem; docs/mixed_precision.md).
+        # `precision` names a dtype policy ("fp32" default — bit-exact with
+        # pre-precision behavior, test-enforced; "bf16" = fp32 master params
+        # + bf16 compute; "fp16" adds dynamic loss scaling automatically).
+        # `loss_scale` overrides the scaling choice ("dynamic" | "none" | a
+        # precision.DynamicScale/NoOpScale instance; None = policy default).
+        # Resolved BEFORE the build hooks so build_model can read
+        # self.model_dtype and match its activation dtype to the policy.
+        # precision_requested distinguishes an explicit precision="fp32" from
+        # an unset knob (the resolved Policy is identical) — entries with a
+        # legacy non-fp32 model default honor the explicit request.
+        self.precision_requested = precision is not None
+        self.precision = get_policy(precision)
+        self._initial_loss_scale = resolve_loss_scale(loss_scale, self.precision)
+        if self.precision.compute_dtype == jnp.float16 and not is_dynamic(
+            self._initial_loss_scale
+        ):
+            raise ValueError(
+                "precision='fp16' requires dynamic loss scaling (fp16 grads "
+                "underflow below ~6e-5 without it): leave loss_scale unset "
+                "or pass loss_scale='dynamic'. Use precision='bf16' for "
+                "scale-free low precision — bf16 keeps fp32's exponent range."
+            )
+        if is_dynamic(self._initial_loss_scale) and nan_policy in (
+            "raise",
+            "restore_last_good",
+        ):
+            raise ValueError(
+                f"nan_policy={nan_policy!r} is incompatible with dynamic loss "
+                "scaling: overflow-skip + backoff IS the scale calibration "
+                "mechanism — 'raise' would abort normal fp16 training on the "
+                "first benign overflow, and 'restore_last_good' would roll "
+                "the whole state back to an old checkpoint (undoing the "
+                "backoff, so the overflow repeats) every time the scale "
+                "probes too high. Use nan_policy=None or 'skip' (skipped "
+                "steps are still counted once in nonfinite_steps and "
+                "state.loss_scale.skipped_steps)."
+            )
         self.skip_corrupt_records = skip_corrupt_records
         # Wall-clock hung-step watchdog: past `step_timeout` seconds without
         # a completed step, SIGTERM ourselves — the preemption handler then
@@ -261,6 +306,8 @@ class Trainer:
             accum_steps=accum_steps,
             schedule=self.schedule,
             nan_guard=self.nan_policy in ("skip", "restore_last_good"),
+            precision=self.precision,
+            loss_scale=self._initial_loss_scale,
         )
 
         # State init (replaces model.to(device) + DDP param broadcast).
@@ -437,9 +484,35 @@ class Trainer:
                 msg += f" | {k} = {v} | "
             self.log(msg)
             self.metrics_writer.write(int(self.state.step), epoch_metrics, prefix="train")
+            self._write_precision_scalars()
 
         self.checkpoints.wait()
         self.log("Finished!")
+
+    @property
+    def model_dtype(self):
+        """The activation dtype matching this trainer's precision policy —
+        pass as ``dtype=`` when constructing models in ``build_model`` so
+        model-internal casts agree with the policy's boundary casts
+        (``jnp.float32`` under the default fp32 policy: identical models)."""
+        return self.precision.compute_dtype
+
+    def _write_precision_scalars(self) -> None:
+        """TensorBoard observability for dynamic loss scaling: the current
+        scale and the cumulative overflow-skip count, next to the train
+        scalars. No-op (like every MetricsWriter call) without tensorboardX
+        or off process 0; no-op entirely unless a DynamicScale is active."""
+        scale_state = getattr(self.state, "loss_scale", None)
+        if not is_dynamic(scale_state):
+            return
+        self.metrics_writer.write(
+            int(self.state.step),
+            {
+                "loss_scale": float(scale_state.scale),
+                "skipped_steps": float(scale_state.skipped_steps),
+            },
+            prefix="precision",
+        )
 
     def _validate_chain_config(self) -> None:
         """Reject/round knob combinations that would silently misalign with

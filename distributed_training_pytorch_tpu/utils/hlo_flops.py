@@ -22,7 +22,13 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["itemize_hlo_matmul_flops", "executed_matmul_flops", "xla_cost_analysis"]
+__all__ = [
+    "itemize_hlo_matmul_flops",
+    "executed_matmul_flops",
+    "xla_cost_analysis",
+    "bytes_accessed",
+    "arithmetic_intensity",
+]
 
 
 def xla_cost_analysis(compiled) -> dict:
@@ -33,6 +39,37 @@ def xla_cost_analysis(compiled) -> dict:
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     return dict(cost)
+
+
+def bytes_accessed(compiled) -> float | None:
+    """XLA's ``bytes accessed`` estimate for a compiled executable: total
+    HBM traffic (operand reads + output writes, post-fusion) the cost model
+    attributes to the program. The memory-side twin of the ``flops`` entry —
+    together they place a program on the roofline. For a ``lax.scan``-chained
+    program the body is counted once, matching the FLOP convention. None when
+    the backend reports no cost analysis (e.g. some relay/plugin paths)."""
+    value = xla_cost_analysis(compiled).get("bytes accessed")
+    return float(value) if value is not None else None
+
+
+def arithmetic_intensity(compiled, *, flops: float | None = None) -> float | None:
+    """FLOPs per HBM byte — the roofline x-coordinate. Above the machine's
+    peak_FLOPs/peak_bandwidth ridge point a program can be compute-bound;
+    below it the bandwidth floor caps MFU no matter the dtype. Mixed
+    precision moves BOTH axes (bf16 halves the bytes of every activation/
+    weight access and doubles MXU peak), which is why the precision sweep in
+    ``docs/performance.md`` reports intensity per dtype.
+
+    ``flops`` overrides the numerator (e.g. the analytic model count);
+    default is ``cost_analysis()``'s executed estimate. None when either
+    side of the ratio is unavailable or zero."""
+    denom = bytes_accessed(compiled)
+    if not denom:
+        return None
+    numer = flops if flops is not None else float(xla_cost_analysis(compiled).get("flops", 0.0))
+    if not numer:
+        return None
+    return numer / denom
 
 DEF_RE = re.compile(r"^(?:ROOT )?%([\w.\-]+) = \w+\[([0-9,]*)\]")
 CONV_RE = re.compile(r" convolution\((.*?)\), window={(.*?)}, dim_labels=(\S+?)[,\s]")

@@ -4,8 +4,11 @@ The reference logs metrics only to console + file (``utils/logger.py``); the
 TPU-equivalent observability stack adds a TensorBoard scalar stream next to
 the profiler traces (``utils.profiling``), so one TensorBoard instance shows
 both. Backend: ``tensorboardX`` when importable, else a no-op (the framework
-never hard-depends on it). Process 0 writes; other hosts get a no-op writer —
-metrics are global (collectively reduced) so one writer sees everything.
+never hard-depends on it — the Trainer's precision scalars
+(``precision/loss_scale``, ``precision/skipped_steps`` under a dynamic loss
+scale) ride the same contract and stay silent without the backend). Process
+0 writes; other hosts get a no-op writer — metrics are global (collectively
+reduced) so one writer sees everything.
 """
 
 from __future__ import annotations

@@ -62,8 +62,11 @@ class ExampleTrainer(Trainer):
     def build_model(self):
         # VGG16(in_channels=3, out_channels=len(labels), init_weights=True)
         # analog (``example_trainer.py:51-52``); Kaiming init is the model's
-        # default initializer.
-        return VGG16(num_classes=len(self.labels))
+        # default initializer. Activations follow the trainer's precision
+        # policy (model_dtype is float32 under the default fp32 policy —
+        # reference-parity; Trainer(precision="bf16") switches compute to
+        # bf16 with fp32 master weights, docs/mixed_precision.md).
+        return VGG16(num_classes=len(self.labels), dtype=self.model_dtype)
 
     # mask-weighted metrics below satisfy the padded-validation contract
     # (trainer.validate warns when this is not declared)

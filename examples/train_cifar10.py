@@ -8,7 +8,8 @@ back to a synthetic CIFAR-shaped set so the pipeline is still exercisable.
 
 Env knobs: ``CIFAR10_DIR`` (default ./data/cifar-10-batches-py), ``EPOCHS``
 (default 100), ``BATCH`` (global, default 1024), ``BASE_LR`` (default 0.1,
-linearly scaled by BATCH/256), ``SAVE_DIR`` (default ./runs/cifar10).
+linearly scaled by BATCH/256), ``SAVE_DIR`` (default ./runs/cifar10),
+``DTYPE`` (fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
 """
 
 from __future__ import annotations
@@ -78,11 +79,22 @@ class Cifar10Transform:
         return np.ascontiguousarray((out - CIFAR_MEAN) / CIFAR_STD)
 
 
+# DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 — sets the trainer's mixed-
+# precision policy AND the model compute dtype together (fp16 auto-enables
+# dynamic loss scaling; docs/mixed_precision.md). Unset keeps this entry's
+# historical program: bf16 model-internal casts under the default (inactive)
+# fp32 policy. Model dtype resolves via precision.model_dtype_for_entry
+# against the trainer's RESOLVED policy, so an explicit precision= ctor
+# override agrees with build_model even when the env knob is unset.
+DTYPE = os.environ.get("DTYPE") or None
+
+
 class Cifar10Trainer(Trainer):
     def __init__(self, data_dir: str, base_lr: float, **kw):
         data = load_cifar10(data_dir)
         self.train_x, self.train_y, self.test_x, self.test_y = data
         self.base_lr = base_lr
+        kw.setdefault("precision", DTYPE)  # env default; callers may override
         super().__init__(**kw)
 
     def _transform(self, train: bool):
@@ -118,7 +130,14 @@ class Cifar10Trainer(Trainer):
         )
 
     def build_model(self):
-        model = VGG16(num_classes=10, dtype=jnp.bfloat16)
+        from distributed_training_pytorch_tpu.precision import model_dtype_for_entry
+
+        model = VGG16(
+            num_classes=10,
+            dtype=model_dtype_for_entry(
+                self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
+            ),
+        )
         if self._device_normalize:
             from distributed_training_pytorch_tpu.models import InputNormalizer
 

@@ -21,7 +21,8 @@ dataset-appropriate, exactly as the reference's own pipeline is tuned to its
 
 Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``EPOCHS`` (default 150),
 ``BATCH`` (global, default 128), ``DIGITS_LR``, ``SAVE_DIR`` (default
-./runs/digits).
+./runs/digits), ``DTYPE`` (fp32|bf16|fp16 mixed-precision policy, default
+fp32 — docs/mixed_precision.md).
 """
 
 from __future__ import annotations
@@ -122,6 +123,10 @@ if __name__ == "__main__":
         max_epoch=int(os.environ.get("EPOCHS", "150")),
         batch_size=int(os.environ.get("BATCH", "128")),
         chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 mixed-precision policy;
+        # the model's activation dtype follows via ExampleTrainer.build_model
+        # (docs/mixed_precision.md). Default fp32 = reference parity.
+        precision=os.environ.get("DTYPE") or None,
         have_validate=True,
         save_best_for=("accuracy", "geq"),
         save_period=int(os.environ.get("SAVE_PERIOD", "25")),

@@ -22,7 +22,8 @@ env for pods (see run.sh). Env knobs: ``IMAGENET_RECORDS`` (glob or dir of
 .rec shards), ``VAL_RECORDS``, ``EPOCHS``, ``BATCH`` (global), ``ACCUM``
 (grad-accum microsteps; default 4 for convnext_l else 1), ``BASE_LR``,
 ``IMAGE_SIZE`` (default 224), ``NUM_CLASSES`` (default 1000; 21841 for
-convnext_l), ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``.
+convnext_l), ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``, ``DTYPE``
+(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
 """
 
 from __future__ import annotations
@@ -116,6 +117,15 @@ class _LimitedSource:
         return self.source[index]
 
 
+# DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 — mixed-precision policy +
+# model compute dtype together (fp16 auto-enables dynamic loss scaling;
+# docs/mixed_precision.md). Unset keeps the historical program: bf16
+# model-internal casts under the default (inactive) fp32 policy. Model dtype
+# resolves against the trainer's RESOLVED policy (model_dtype_for_entry) so
+# an explicit precision= ctor override agrees with build_model.
+DTYPE = os.environ.get("DTYPE") or None
+
+
 class ImageNetTrainer(Trainer):
     criterion_uses_mask = True
 
@@ -127,6 +137,7 @@ class ImageNetTrainer(Trainer):
         self.num_classes = int(os.environ.get("NUM_CLASSES", self.recipe["num_classes"]))
         self.train_records = os.environ.get("IMAGENET_RECORDS")
         self.val_records = os.environ.get("VAL_RECORDS")
+        kw.setdefault("precision", DTYPE)  # env default; callers may override
         super().__init__(**kw)
 
     def build_train_dataset(self):
@@ -171,7 +182,15 @@ class ImageNetTrainer(Trainer):
         return synthetic_source(1024, self.image_size, self.num_classes, tfm, seed=1)
 
     def build_model(self):
-        model = create_model(self.model_name, num_classes=self.num_classes, dtype=jnp.bfloat16)
+        from distributed_training_pytorch_tpu.precision import model_dtype_for_entry
+
+        model = create_model(
+            self.model_name,
+            num_classes=self.num_classes,
+            dtype=model_dtype_for_entry(
+                self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
+            ),
+        )
         if _ship_uint8():
             from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer
 

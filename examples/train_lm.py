@@ -12,7 +12,8 @@ build a real one offline with ``examples/make_lm_corpus.py``), ``SEQ_LEN``
 ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``, ``LM_SIZE`` (``tiny`` | ``small``
 = GPT-2-small shape), ``SAVE_PERIOD`` / ``LAST_SAVE_PERIOD`` (epochs between
 periodic / `last` saves — raise both when the checkpoint path is slow, e.g.
-a chip behind a relay where a GPT-small save costs minutes).
+a chip behind a relay where a GPT-small save costs minutes), ``DTYPE``
+(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
 """
 
 from __future__ import annotations
@@ -62,6 +63,15 @@ def load_windows(seq_len: int, path: str | None = None) -> np.ndarray:
     return windows.astype(np.int32)
 
 
+# DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 — mixed-precision policy +
+# model compute dtype together (fp16 auto-enables dynamic loss scaling;
+# docs/mixed_precision.md). Unset keeps the historical program: bf16
+# model-internal casts under the default (inactive) fp32 policy. Model dtype
+# resolves against the trainer's RESOLVED policy (model_dtype_for_entry) so
+# an explicit precision= ctor override agrees with build_model.
+DTYPE = os.environ.get("DTYPE") or None
+
+
 class LMTrainer(Trainer):
     def __init__(self, seq_len: int, base_lr: float, size: str, moe_every: int, **kw):
         self.seq_len = seq_len
@@ -69,6 +79,7 @@ class LMTrainer(Trainer):
         self.size = size
         self.moe_every = moe_every
         self.windows = load_windows(seq_len)
+        kw.setdefault("precision", DTYPE)  # env default; callers may override
         super().__init__(**kw)
 
     # tokens ride the loader's "image" slot; targets are the shifted window.
@@ -81,10 +92,14 @@ class LMTrainer(Trainer):
         return ArrayDataSource(image=w[:, :-1], label=w[:, 1:])
 
     def build_model(self):
+        from distributed_training_pytorch_tpu.precision import model_dtype_for_entry
+
         factory = {"tiny": LMTiny, "small": GPTSmall}[self.size]
         return factory(
             vocab_size=256,
-            dtype=jnp.bfloat16,
+            dtype=model_dtype_for_entry(
+                self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
+            ),
             moe_every=self.moe_every,
             max_len=max(self.seq_len, 128),
         )

@@ -27,7 +27,8 @@ is not a valid digit, same reasoning as ``train_digits.py``).
 Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``RECORDS_DIR`` (default
 <DIGITS_DIR>/records), ``EPOCHS`` (default 60), ``BATCH`` (global, default
 128), ``RECORDS_LR`` (default 0.1, x BATCH/256), ``SAVE_DIR`` (default
-./runs/records_digits).
+./runs/records_digits), ``DTYPE`` (fp32|bf16|fp16 mixed-precision policy —
+docs/mixed_precision.md).
 """
 
 from __future__ import annotations
@@ -74,6 +75,15 @@ def pack_digits(digits_dir: str, records_dir: str) -> dict:
     }
 
 
+# DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 — mixed-precision policy +
+# model compute dtype together (fp16 auto-enables dynamic loss scaling;
+# docs/mixed_precision.md). Unset keeps the historical program: bf16
+# model-internal casts under the default (inactive) fp32 policy. Model dtype
+# resolves against the trainer's RESOLVED policy (model_dtype_for_entry) so
+# an explicit precision= ctor override agrees with build_model.
+DTYPE = os.environ.get("DTYPE") or None
+
+
 class RecordsDigitsTrainer(Trainer):
     criterion_uses_mask = True
 
@@ -81,6 +91,7 @@ class RecordsDigitsTrainer(Trainer):
         self.train_pattern = train_pattern
         self.val_pattern = val_pattern
         self.base_lr = base_lr
+        kw.setdefault("precision", DTYPE)  # env default; callers may override
         super().__init__(**kw)
 
     def build_train_dataset(self):
@@ -95,8 +106,15 @@ class RecordsDigitsTrainer(Trainer):
         return NativeRecordFileSource(self.val_pattern, height=SIZE, width=SIZE)
 
     def build_model(self):
+        from distributed_training_pytorch_tpu.precision import model_dtype_for_entry
+
         return InputNormalizer(
-            inner=ResNet18Slim(num_classes=len(LABELS), dtype=jnp.bfloat16),
+            inner=ResNet18Slim(
+                num_classes=len(LABELS),
+                dtype=model_dtype_for_entry(
+                self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
+            ),
+            ),
             mean=list(T.IMAGENET_MEAN),
             std=list(T.IMAGENET_STD),
         )

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Precision smoke — mixed-precision CI gate (ISSUE 3 satellite).
+
+Trains a small classifier on the REAL sklearn digits corpus (the offline
+stand-in every accuracy clause uses) for a few epochs in ``precision="bf16"``
+with the non-finite guard armed (``nan_policy="skip"``), and asserts:
+
+* the loss actually decreases — a policy regression that silently zeroes
+  grads (e.g. a cast detaching the params from the graph) fails here in
+  seconds, not as a flat curve on real hardware;
+* zero steps were skipped — bf16 training needs no loss scaling, so any
+  ``nonfinite`` count means the precision path manufactured an overflow;
+* the compute really ran in bf16 (logit dtype probed at trace time) while
+  the master weights stayed fp32 — the policy's core contract.
+
+Fails fast (nonzero exit) so ``scripts/verify.sh`` catches precision
+regressions the way the retrace guard catches dispatch regressions.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.data import ArrayDataSource
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.trainer import Trainer
+
+SEEN_LOGIT_DTYPES: set = set()
+
+
+class DigitsNet(nn.Module):
+    """Dtype-inferring MLP (no forced casts): nn.Dense with dtype=None runs
+    in whatever dtype the policy hands it — exactly the model class the
+    boundary-cast design serves."""
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+class SmokeTrainer(Trainer):
+    def build_train_dataset(self):
+        from sklearn.datasets import load_digits
+
+        digits = load_digits()
+        images = (digits.images / 16.0).astype(np.float32)[..., None]
+        return ArrayDataSource(
+            image=images, label=digits.target.astype(np.int32)
+        )
+
+    def build_model(self):
+        return DigitsNet()
+
+    def build_criterion(self):
+        def criterion(logits, batch):
+            SEEN_LOGIT_DTYPES.add(str(logits.dtype))  # trace-time probe
+            loss = cross_entropy_loss(logits, batch["label"])
+            return loss, {"loss": loss}
+
+        return criterion
+
+    def build_optimizer(self, schedule):
+        return optax.sgd(schedule, momentum=0.9)
+
+    def build_scheduler(self):
+        return 0.1
+
+
+class _Recorder(SmokeTrainer):
+    epoch_losses: list
+
+    def train_epoch(self, epoch):
+        metrics = super().train_epoch(epoch)
+        self.epoch_losses.append(metrics["loss"])
+        return metrics
+
+
+def main() -> int:
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="precision_smoke_")
+    try:
+        trainer = _Recorder(
+            max_epoch=3,
+            batch_size=128,
+            save_folder=tmp,
+            precision="bf16",
+            nan_policy="skip",  # arm the guard so a skip would be COUNTED
+            num_workers=0,
+            log_every=0,
+            async_checkpoint=False,
+            progress=False,
+            logger=type("Q", (), {"log": staticmethod(lambda *a, **k: None)})(),
+        )
+        trainer.epoch_losses = []
+        trainer.train()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    errors = []
+    first, last = trainer.epoch_losses[0], trainer.epoch_losses[-1]
+    if not last < first * 0.7:
+        errors.append(f"loss did not decrease under bf16: {trainer.epoch_losses}")
+    if trainer.nonfinite_steps:
+        errors.append(
+            f"{trainer.nonfinite_steps} steps skipped — bf16 must not overflow"
+        )
+    if "bfloat16" not in SEEN_LOGIT_DTYPES:
+        errors.append(f"compute did not run in bf16 (logit dtypes: {SEEN_LOGIT_DTYPES})")
+    bad = [
+        str(p.dtype)
+        for p in jax.tree.leaves(trainer.state.params)
+        if str(p.dtype) != "float32"
+    ]
+    if bad:
+        errors.append(f"master weights not fp32: {bad}")
+    if errors:
+        print("PRECISION SMOKE FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(
+        f"precision smoke OK: bf16 digits loss {first:.3f} -> {last:.3f}, "
+        f"0 skipped steps, fp32 master weights"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

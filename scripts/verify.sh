@@ -13,12 +13,16 @@
 # that silently retraces every window fails here in seconds instead of as a
 # mysterious multi-minute-per-window slowdown on real hardware.
 #
-# Stage 3 is the ROADMAP.md tier-1 command verbatim.
+# Stage 3 is a ~10s CPU digits run in precision="bf16" asserting the loss
+# decreases, no steps are skipped, compute runs in bf16, and master weights
+# stay fp32 — precision regressions fail fast like retrace regressions.
+#
+# Stage 4 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/3: import health (pytest --collect-only) =="
+echo "== stage 1/4: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -27,13 +31,19 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/3: chained-dispatch retrace guard =="
+echo "== stage 2/4: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 3
 fi
 
-echo "== stage 3/3: tier-1 test suite =="
+echo "== stage 3/4: mixed-precision smoke (bf16 digits) =="
+if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
+  echo "PRECISION SMOKE FAILED — bf16 training path regressed"
+  exit 4
+fi
+
+echo "== stage 4/4: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_training_pytorch_tpu.utils.hlo_flops import (
+    arithmetic_intensity,
+    bytes_accessed,
     executed_matmul_flops,
     itemize_hlo_matmul_flops,
     xla_cost_analysis,
@@ -70,6 +72,37 @@ def test_executed_guard_rejects_unreconciled_counts():
         xla = float(cost.get("flops", 0.0))
         if xla:
             assert 0.3 <= got / xla <= 1.1
+
+
+def test_bytes_accessed_and_arithmetic_intensity():
+    """The roofline pair (ISSUE 3 satellite): bytes accessed surfaces XLA's
+    HBM-traffic estimate and intensity = flops / bytes. A matmul must read at
+    least its operands and write its output; its intensity must reconcile
+    with the two cost_analysis entries it is derived from."""
+    a = jnp.zeros((256, 128), jnp.float32)
+    b = jnp.zeros((128, 64), jnp.float32)
+    compiled = jax.jit(lambda a, b: a @ b).lower(a, b).compile()
+    ba = bytes_accessed(compiled)
+    cost = xla_cost_analysis(compiled)
+    if "bytes accessed" not in cost:
+        assert ba is None  # backend reports no estimate: None, not garbage
+        return
+    assert ba == float(cost["bytes accessed"])
+    assert ba >= 4 * (256 * 128 + 128 * 64 + 256 * 64)  # operands + output
+    ai = arithmetic_intensity(compiled)
+    assert ai is not None and ai > 0
+    np.testing.assert_allclose(ai, float(cost.get("flops", 0.0)) / ba)
+    # numerator override: the analytic-count convention
+    assert arithmetic_intensity(compiled, flops=2.0 * ba) == 2.0
+
+
+def test_arithmetic_intensity_none_without_cost():
+    class FakeNoCost:
+        def cost_analysis(self):
+            return {}
+
+    assert bytes_accessed(FakeNoCost()) is None
+    assert arithmetic_intensity(FakeNoCost()) is None
 
 
 def test_parser_regression_warns_loudly():

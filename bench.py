@@ -40,27 +40,17 @@ import optax
 from distributed_training_pytorch_tpu.models import VGG16
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.telemetry import GoodputMeter
+from distributed_training_pytorch_tpu.telemetry import mfu as mfu_lib
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
 from distributed_training_pytorch_tpu.utils import hlo_flops
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng, tpu_compiler_options
 
-# bf16 peak TFLOP/s per chip, by PJRT device_kind substring.
-PEAK_FLOPS = {
-    "v5 lite": 197e12,  # v5e litepod chip (197 bf16 TFLOP/s)
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v6": 918e12,
-    "cpu": 1e12,  # nominal, for smoke runs
-}
-
-
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return 1e12
+# Peak-FLOPs table + lookup live in telemetry/mfu.py (ISSUE 4) — one source
+# of truth shared with the Trainer's per-window MFU reports; re-exported here
+# under the historical bench names.
+PEAK_FLOPS = mfu_lib.PEAK_FLOPS
+peak_flops = mfu_lib.device_peak_flops
 
 
 def vgg16_train_flops_per_image(model: VGG16, image_size: int) -> float:
@@ -540,20 +530,28 @@ def run_e2e(batch: int, epochs: int, chain_steps: int = 1) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _time_windows(run_once, state, steps, windows, reduce):
+def _time_windows(run_once, state, steps, windows, reduce, meter=None):
     """The one window-timing protocol every measurement uses: warm once, then
     ``windows`` timed windows separated by ``BENCH_WINDOW_GAP_S`` (the shared
     chip's slow phases last tens of seconds; spacing windows samples past
     them), each synced via a scalar device_get (``block_until_ready`` alone
     can be a no-op on relay-backed platforms). ``run_once(state) -> (state,
     metrics)`` runs one window of ``steps`` steps. Returns the carried state
-    and the best (or ``reduce="median"``: median) per-step seconds."""
+    and the best (or ``reduce="median"``: median) per-step seconds.
+
+    ``meter`` (a ``telemetry.GoodputMeter``) attributes the deliberate
+    inter-window gap sleeps to ``other`` — harness pacing is not productive
+    step time; the caller ticks ``productive_step`` after the return."""
     state, m = run_once(state)
     _ = float(m["loss"])
     per_step = []
     for w in range(windows):
         if w:
+            if meter is not None:
+                meter.tick("productive_step")
             time.sleep(float(os.environ.get("BENCH_WINDOW_GAP_S", "5")))
+            if meter is not None:
+                meter.tick("other")
         t0 = time.perf_counter()
         state, m = run_once(state)
         _ = float(m["loss"])
@@ -564,7 +562,15 @@ def _time_windows(run_once, state, steps, windows, reduce):
 
 def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     enable_fast_rng()
+    # Goodput accounting for the bench run itself (ISSUE 4 satellite,
+    # telemetry/goodput.py — the same meter the Trainer carries through
+    # checkpoints): compile vs productive-step vs harness-overhead wall time,
+    # emitted as bucket fractions in the JSON line so a sweep shows where a
+    # config's wall clock went (ConvNeXt-L pays ~10x VGG's compile bill).
+    meter = GoodputMeter()
+    meter.start()
     setup = build_bench_setup(dtype_name=dtype_name)
+    meter.tick("other")  # model build + state init + batch staging
     model_name, cfg = setup["model_name"], setup["cfg"]
     batch, image_size = setup["batch"], setup["image_size"]
     model, engine, state, gbatch = (
@@ -629,12 +635,15 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
                 st, metrics = probe(st, gbatch)
             return st, metrics
 
+    meter.tick("compile")  # the AOT compile above (XLA, one per run)
+
     # Warmup, then best of `windows` timed windows (the shared relay chip's
     # interference only ever subtracts; BENCH_REDUCE=median reports the
     # median instead — measured ~5% below best-of, the spread being relay
     # noise, not step variance: chained windows pin the device loop).
     reduce = os.environ.get("BENCH_REDUCE", "min")
-    state, dt = _time_windows(run_window, state, steps, windows, reduce)
+    state, dt = _time_windows(run_window, state, steps, windows, reduce, meter=meter)
+    meter.tick("productive_step")
 
     # Executed-flops recount from the compiled program — BEFORE the e2e
     # block below may delete the executable (see the mfu comment further
@@ -661,6 +670,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     dispatch = {}
     if chain and os.environ.get("BENCH_DISPATCH_GAP", "1") != "0":
         step_probe = engine.compile_train_step(state, gbatch, compiler_options=opts)
+        meter.tick("compile")
 
         def run_dispatch(st):
             for _ in range(steps):
@@ -668,8 +678,9 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
             return st, pm
 
         state, dt_dispatch = _time_windows(
-            run_dispatch, state, steps, min(3, windows), reduce
+            run_dispatch, state, steps, min(3, windows), reduce, meter=meter
         )
+        meter.tick("productive_step")
         dispatch = {
             "step_ms_dispatch": round(dt_dispatch * 1e3, 2),
             "dispatch_gap_ms": round((dt_dispatch - dt) * 1e3, 2),
@@ -707,9 +718,12 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
         probe_exec = engine.compile_chained_train_steps(
             state, probe_gbatch, steps, compiler_options=opts
         )
+        meter.tick("compile")
         st, probe_dt = _time_windows(
-            lambda s: probe_exec(s, probe_gbatch), state, steps, min(3, windows), reduce
+            lambda s: probe_exec(s, probe_gbatch), state, steps, min(3, windows),
+            reduce, meter=meter,
         )
+        meter.tick("productive_step")
         del st, probe_exec, probe_gbatch
         per_img_main = dt / batch
         per_img_cliff = probe_dt / cliff_batch
@@ -786,6 +800,18 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
             "trainer_vs_step": round(trainer_step_ms / (dt * 1e3), 4),
         }
 
+    # Close the goodput partition (the e2e epochs above, when enabled, run
+    # the full Trainer loop — a separate measurement, booked as harness
+    # `other` here). Fractions must sum to 1: same invariant the
+    # scripts/telemetry_smoke.py gate enforces for trainer runs.
+    meter.stop("other")
+    fractions = meter.fractions()
+    assert abs(sum(fractions.values()) - 1.0) < 1e-6, fractions
+    goodput_fields = {
+        "goodput": {k: round(v, 4) for k, v in fractions.items() if v},
+        "goodput_wall_s": round(meter.total(), 2),
+    }
+
     n_chips = len(jax.devices())
     items = batch * cfg["items_per_row"](image_size)
     images_per_sec = items / dt
@@ -830,9 +856,11 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
 
         xla_step_flops = _rescale(xla_step_flops)
         exec_step_flops = _rescale(exec_step_flops)
-    mfu = step_flops / dt / peak
-    mfu_exec = exec_step_flops / dt / peak if exec_step_flops else None
-    mfu_xla = xla_step_flops / dt / peak if xla_step_flops else 0.0
+    # MFU assembly via telemetry/mfu.py — the same flops/dt/peak ratio the
+    # Trainer's per-window telemetry reports (one implementation, ISSUE 4).
+    mfu = mfu_lib.mfu_value(step_flops, dt, peak) or 0.0
+    mfu_exec = mfu_lib.mfu_value(exec_step_flops or 0.0, dt, peak)
+    mfu_xla = mfu_lib.mfu_value(xla_step_flops, dt, peak) or 0.0
 
     print(
         json.dumps(
@@ -882,6 +910,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
                 ),
                 **dispatch,
                 **cliff_probe,
+                **goodput_fields,
                 **e2e,
                 **trainer_loop,
             }

@@ -27,6 +27,9 @@ offline evaluator — rebuilt TPU-first:
 * ``fault``     — fault-injection harness (``FaultPlan``) + hung-step
   watchdog: preemption, torn saves, NaN steps, and corrupt records as
   tested code paths.
+* ``telemetry`` — run observability: structured JSONL event log, goodput
+  wall-time buckets (cumulative across kill/resume), on-device train-health
+  stats, MFU/roofline fields, anomaly detectors (docs/observability.md).
 * ``compat``    — JAX version shims (``shard_map`` API move, ambient-mesh
   helpers) so one codebase spans the supported JAX range.
 * ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.
@@ -54,4 +57,8 @@ from distributed_training_pytorch_tpu.precision import (  # noqa: F401
     DynamicScale,
     NoOpScale,
     Policy,
+)
+from distributed_training_pytorch_tpu.telemetry import (  # noqa: F401
+    AnomalyDetector,
+    Telemetry,
 )

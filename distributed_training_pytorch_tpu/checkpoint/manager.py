@@ -212,6 +212,7 @@ class CheckpointManager:
         epoch: int,
         metrics: Mapping | None = None,
         loop_state: Mapping | None = None,
+        telemetry: Mapping | None = None,
     ) -> None:
         """Collective save of ``state`` + meta under ``directory/name``.
 
@@ -223,6 +224,11 @@ class CheckpointManager:
         ``loop_state`` carries mid-epoch resume info (e.g. ``step_in_epoch``
         for a preemption save) into the meta json, so a resumed run can skip
         already-trained batches and stay bit-exact with an uninterrupted one.
+
+        ``telemetry`` carries cumulative run-accounting counters (the
+        trainer's goodput buckets, ``telemetry/goodput.py``) into the meta
+        json the same way — json round-trips Python floats exactly, so a
+        resumed run's counters are bit-identical to the saved ones.
         """
         self.wait()  # a name may be overwritten; finish any in-flight save first
         self._gc_periodic()  # previous save is committed; safe to prune now
@@ -239,6 +245,8 @@ class CheckpointManager:
             meta["metrics"] = {k: float(v) for k, v in metrics.items()}
         if loop_state is not None:
             meta["loop"] = {k: int(v) for k, v in loop_state.items()}
+        if telemetry is not None:
+            meta["telemetry"] = dict(telemetry)
         # Typed PRNG keys carry an extended dtype serializers reject; store
         # the raw key words + impl name and rebuild on restore (this is also
         # what makes params_only restores work across PRNG impls — key
@@ -406,7 +414,9 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
 
-    def maybe_save_best(self, metrics: Mapping, state: Any, epoch: int) -> bool:
+    def maybe_save_best(
+        self, metrics: Mapping, state: Any, epoch: int, telemetry: Mapping | None = None
+    ) -> bool:
         """Apply the best-fitness rule; save under ``best`` on improvement.
 
         Returns True when a new best was saved (``trainer/trainer.py:118-130``).
@@ -426,7 +436,7 @@ class CheckpointManager:
         )
         if improved:
             self._best_value = value
-            self.save(BEST, state, epoch, metrics=metrics)
+            self.save(BEST, state, epoch, metrics=metrics, telemetry=telemetry)
         return improved
 
     # -- integrity ---------------------------------------------------------

@@ -1,0 +1,125 @@
+"""Telemetry subsystem (ISSUE 4): unified run observability.
+
+Production training treats goodput and MFU as first-class run metrics; this
+package assembles the raw ingredients the other subsystems already produce
+(``utils.hlo_flops`` cost analysis, ``TrainEngine.trace_counts``, fault /
+preemption events, loss-scale state) into one surface:
+
+* :mod:`~.events`  — structured JSONL event log (run start/end, compile,
+  checkpoint save/restore, preemption, fault injection, loss-scale backoff,
+  anomaly) with monotonic timestamps and rank-0 file ownership;
+* :mod:`~.goodput` — wall time partitioned into productive-step / compile /
+  data-wait / checkpoint / restart-rollback buckets, cumulative across
+  kill/resume (counters ride checkpoint meta);
+* :mod:`~.stats`   — on-device train-health statistics (grad/param norm,
+  update ratio, nonfinite flag) computed inside the compiled step: zero
+  extra host syncs, zero retraces, bit-exact chained windows;
+* :mod:`~.mfu`     — MFU + roofline fields from cost analysis and measured
+  step time, shared by ``bench.py`` and the trainer's per-window reports;
+* :mod:`~.anomaly` — host-side detectors (loss spike / grad explosion /
+  step-time regression) that run only at existing sync points.
+
+Wire-up: ``Trainer(telemetry="on")`` (or a :class:`Telemetry` instance for
+knobs); entries honor ``TELEMETRY=1``; see ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distributed_training_pytorch_tpu.telemetry.anomaly import (  # noqa: F401
+    Anomaly,
+    AnomalyDetector,
+    AnomalyError,
+)
+from distributed_training_pytorch_tpu.telemetry.events import (  # noqa: F401
+    EventLog,
+    read_events,
+)
+from distributed_training_pytorch_tpu.telemetry.goodput import (  # noqa: F401
+    BUCKETS,
+    GoodputMeter,
+)
+from distributed_training_pytorch_tpu.telemetry.mfu import (  # noqa: F401
+    PEAK_FLOPS,
+    device_peak_flops,
+    mfu_value,
+    window_report,
+)
+from distributed_training_pytorch_tpu.telemetry.stats import (  # noqa: F401
+    STAT_KEYS,
+    train_health_stats,
+)
+
+__all__ = [
+    "Anomaly",
+    "AnomalyDetector",
+    "AnomalyError",
+    "BUCKETS",
+    "EventLog",
+    "GoodputMeter",
+    "PEAK_FLOPS",
+    "STAT_KEYS",
+    "Telemetry",
+    "device_peak_flops",
+    "mfu_value",
+    "read_events",
+    "resolve_telemetry",
+    "train_health_stats",
+    "window_report",
+]
+
+
+@dataclasses.dataclass
+class Telemetry:
+    """The ``Trainer(telemetry=...)`` configuration bundle.
+
+    * ``events_path``    — JSONL event-log path (None = the trainer default,
+      ``<save_folder>/telemetry/events.jsonl``);
+    * ``stats``          — on-device train-health stats in every step's
+      metrics (``telemetry.stats``);
+    * ``goodput``        — wall-time bucket accounting + checkpoint carry;
+    * ``mfu``            — per-window MFU. When ``flops_per_step`` is None
+      the trainer probes XLA's per-step FLOP estimate once via
+      ``TrainEngine.step_cost_analysis`` at the end of the first trained
+      epoch — one extra (off-hot-path) XLA compile that never touches the
+      dispatch executables or their trace counts;
+    * ``flops_per_step`` — analytic per-step FLOP override (skips the probe;
+      e.g. ``bench.vgg16_train_flops_per_image(model, size) * batch``);
+    * ``anomaly``        — ``"warn"`` (default) | ``"raise"`` | ``None`` |
+      an :class:`AnomalyDetector` instance with custom thresholds.
+    """
+
+    events_path: str | None = None
+    stats: bool = True
+    goodput: bool = True
+    mfu: bool = True
+    flops_per_step: float | None = None
+    anomaly: AnomalyDetector | str | None = "warn"
+
+    def resolve_anomaly(self) -> AnomalyDetector | None:
+        if self.anomaly is None:
+            return None
+        if isinstance(self.anomaly, AnomalyDetector):
+            return self.anomaly
+        return AnomalyDetector(action=str(self.anomaly))
+
+
+def resolve_telemetry(spec) -> Telemetry | None:
+    """Trainer-knob resolution: ``None``/``False`` = off (the historical
+    program, byte-for-byte); ``True``/``"on"``/``"1"`` = defaults; a
+    :class:`Telemetry` instance passes through."""
+    if spec is None or spec is False:
+        return None
+    if spec is True:
+        return Telemetry()
+    if isinstance(spec, str):
+        key = spec.lower()
+        if key in ("on", "1", "true", "default"):
+            return Telemetry()
+        if key in ("off", "0", "false", "none"):
+            return None
+        raise ValueError(f"unknown telemetry spec {spec!r} (use 'on', 'off', or a Telemetry)")
+    if isinstance(spec, Telemetry):
+        return spec
+    raise TypeError(f"telemetry must be None, bool, str, or Telemetry, got {type(spec)}")

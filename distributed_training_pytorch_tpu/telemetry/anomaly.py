@@ -1,0 +1,131 @@
+"""Host-side anomaly detectors: loss spikes, grad explosions, step-time
+regressions.
+
+These run ONLY at the trainer's existing host sync points (``log_every``
+boundaries and epoch end), on metric values the sync already fetched —
+detection adds zero device syncs, exactly like ``Trainer._apply_nan_policy``.
+Each signal keeps an exponentially weighted moving average as its baseline;
+a value exceeding ``factor x baseline`` (after a warmup of observations, so
+the noisy first steps never false-positive) is an anomaly. Non-finite loss
+or grad-norm values are always anomalous (no baseline needed).
+
+The detector only *detects*; policy lives with the caller: the trainer
+emits an ``anomaly`` event + a warning log line per finding, and raises
+:class:`AnomalyError` when constructed with ``action="raise"`` (the
+observability analog of ``nan_policy="raise"`` — useful for sweeps where a
+diverged run should die early, not burn its remaining budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+__all__ = ["Anomaly", "AnomalyError", "AnomalyDetector"]
+
+
+class AnomalyError(RuntimeError):
+    """Raised by the trainer (``action="raise"``) when a detector fires."""
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """One finding: ``kind`` is ``loss_spike`` | ``grad_explosion`` |
+    ``step_time_regression``; ``value`` tripped at ``factor`` x
+    ``baseline`` (the EWMA at detection time) at global step ``step``."""
+
+    kind: str
+    step: int
+    value: float
+    baseline: float
+    factor: float
+
+    def describe(self) -> str:
+        return (
+            f"{self.kind} at step {self.step}: {self.value:.4g} vs "
+            f"baseline {self.baseline:.4g} (threshold x{self.factor:g})"
+        )
+
+
+class AnomalyDetector:
+    """EWMA-baselined detectors over the trainer's host-synced metrics.
+
+    ``loss_spike`` / ``grad_explosion`` / ``step_time_regression`` are the
+    trip factors (None disables that signal's threshold comparison — a
+    non-finite value still fires); ``ewma_alpha`` the baseline's
+    smoothing; ``warmup`` the observations per signal before it can fire
+    (compile-skewed first windows and init-transient losses are normal).
+    """
+
+    def __init__(
+        self,
+        *,
+        action: str = "warn",
+        loss_spike: float | None = 3.0,
+        grad_explosion: float | None = 10.0,
+        step_time_regression: float | None = 2.5,
+        ewma_alpha: float = 0.1,
+        warmup: int = 5,
+    ):
+        if action not in ("warn", "raise"):
+            raise ValueError(f"action must be 'warn' or 'raise', got {action!r}")
+        self.action = action
+        self._factors = {
+            "loss_spike": loss_spike,
+            "grad_explosion": grad_explosion,
+            "step_time_regression": step_time_regression,
+        }
+        self.ewma_alpha = float(ewma_alpha)
+        self.warmup = int(warmup)
+        self._ewma: dict[str, float] = {}
+        self._seen: dict[str, int] = {}
+        self.total_fired = 0
+
+    def _check(self, kind: str, value: float | None, step: int) -> Anomaly | None:
+        factor = self._factors[kind]
+        if value is None:
+            return None
+        value = float(value)
+        baseline = self._ewma.get(kind)
+        seen = self._seen.get(kind, 0)
+        anomaly = None
+        if not math.isfinite(value):
+            # Non-finite is anomalous unconditionally — even for a signal
+            # whose threshold factor is disabled (None turns off the EWMA
+            # comparison, not NaN detection) — and must NOT be folded into
+            # the baseline (one NaN would poison the EWMA for the rest of
+            # the run).
+            return Anomaly(kind, step, value, baseline or 0.0, factor or 0.0)
+        if factor is None:
+            return None
+        if baseline is not None and seen >= self.warmup and value > factor * abs(baseline):
+            anomaly = Anomaly(kind, step, value, baseline, factor)
+        # Baseline update AFTER the check; a detected spike still feeds in
+        # with bounded (alpha) weight, so a persistent regime shift re-bases
+        # instead of alarming forever.
+        a = self.ewma_alpha
+        self._ewma[kind] = value if baseline is None else (1 - a) * baseline + a * value
+        self._seen[kind] = seen + 1
+        return anomaly
+
+    def observe(
+        self,
+        step: int,
+        *,
+        loss: float | None = None,
+        grad_norm: float | None = None,
+        step_time: float | None = None,
+    ) -> list[Anomaly]:
+        """Feed one sync point's values; returns the anomalies fired (empty
+        list almost always). ``step`` labels findings only."""
+        found = []
+        for kind, value in (
+            ("loss_spike", loss),
+            ("grad_explosion", grad_norm),
+            ("step_time_regression", step_time),
+        ):
+            a = self._check(kind, value, int(step))
+            if a is not None:
+                found.append(a)
+        self.total_fired += len(found)
+        return found

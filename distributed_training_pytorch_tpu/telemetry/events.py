@@ -1,0 +1,171 @@
+"""Structured JSONL event log — the run's flight recorder.
+
+The framework's narrative observability so far lived in free-text log lines
+(``utils/logger.py``); answering "why did the loss spike at step 12k?" or
+"how many preemptions did this run survive?" meant regexing a logfile. The
+event log records the run's *discrete* happenings — run start/end,
+compilation, checkpoint save/restore, preemption, fault injection,
+loss-scale backoff, anomaly — as one JSON object per line, machine-readable
+and append-only.
+
+Conventions:
+
+* **Rank-0 file ownership** (the logger's multi-host convention,
+  ``utils/logger.py``): only process 0 writes the file; other processes get
+  a disabled no-op writer. Events are global run facts (the trainer emits
+  them at points every host reaches), so one writer sees everything — and a
+  shared filesystem never sees interleaved half-lines from N writers.
+* **Monotonic timestamps**: every record carries ``t_mono``
+  (``time.monotonic()`` — ordering-safe across NTP slews) next to ``t_wall``
+  (``time.time()`` — human-correlatable). Within one process the ``t_mono``
+  stream is nondecreasing by construction.
+* **Append mode**: a resumed run appends to the same file, so the log shows
+  the full preempt/restart history (each attempt opens with its own
+  ``run_start``). Crash-safe: every record is flushed line-atomically, and
+  a torn last line from a hard kill is newline-terminated on reopen so
+  records never merge (``read_events(strict=False)`` audits past it).
+* **Never the reason a run dies**: emit failures (disk full, permission)
+  disable the log with one warning instead of raising into the step loop.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import socket
+import time
+from typing import Any, Iterator
+
+import jax
+
+__all__ = ["EventLog", "read_events"]
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort scalar coercion: numpy/jax scalars -> python, everything
+    non-serializable -> repr (an event must never fail to serialize).
+
+    Non-finite floats become their repr strings ("nan"/"inf"/"-inf"):
+    json.dumps would otherwise emit bare ``NaN``/``Infinity`` literals —
+    Python-parseable but invalid strict JSON, which jq / JSON.parse reject.
+    The value (e.g. an anomaly's NaN loss) is payload, so it is preserved
+    as a string rather than dropped."""
+    if isinstance(value, float):
+        return value if math.isfinite(value) else repr(value)
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    try:
+        value = float(value)  # numpy / jax 0-d scalars
+    except (TypeError, ValueError):
+        return repr(value)
+    return value if math.isfinite(value) else repr(value)
+
+
+class EventLog:
+    """``EventLog(path).emit("checkpoint_save", name="last", epoch=3)``.
+
+    ``path=None`` (or a non-zero process index) constructs a disabled no-op
+    writer — the universal telemetry-off contract, mirroring
+    ``utils.tensorboard.MetricsWriter``.
+    """
+
+    def __init__(self, path: str | None, *, process_index: int | None = None):
+        self._path = path
+        self._file = None
+        self._dead = False  # a failed write disables the log permanently
+        proc = jax.process_index() if process_index is None else process_index
+        self.process = proc
+        self.enabled = path is not None and proc == 0
+        self._host = socket.gethostname()
+
+    def _open(self):
+        if self._file is None:
+            os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+            # Torn-last-line repair: a hard kill (SIGKILL, power loss) can
+            # leave a partial record with no trailing newline; appending the
+            # resumed run's first event onto it would merge two records into
+            # one unparseable line. Terminate the fragment first — it stays
+            # in the log as its own (malformed) line marking the crash.
+            try:
+                with open(self._path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    torn = f.read(1) != b"\n"
+            except (OSError, ValueError):  # missing or empty file
+                torn = False
+            self._file = open(self._path, "a", encoding="utf-8")
+            if torn:
+                self._file.write("\n")
+        return self._file
+
+    def emit(self, event: str, **fields) -> dict | None:
+        """Append one event record; returns the record dict (or None when
+        disabled). Field values are coerced to JSON-safe scalars."""
+        if not self.enabled or self._dead:
+            return None
+        record = {
+            "event": str(event),
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+            "process": self.process,
+            "host": self._host,
+            "pid": os.getpid(),
+        }
+        for key, value in fields.items():
+            record[str(key)] = _jsonable(value)
+        try:
+            f = self._open()
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+        except OSError as e:
+            # Telemetry must never kill training: disable and move on.
+            self._dead = True
+            import warnings
+
+            warnings.warn(f"EventLog disabled — write to {self._path!r} failed: {e}")
+            return None
+        return record
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None  # a later emit() lazily reopens (append mode)
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_events(path: str, *, strict: bool = True) -> Iterator[dict]:
+    """Parse an event log back into dicts — the test/smoke-side consumer.
+
+    ``strict=True`` (default) raises ``ValueError`` naming the offending
+    line on malformed JSONL — the CI-gate behavior, where a bad line means
+    the writer regressed. ``strict=False`` skips malformed lines with a
+    warning — for post-crash audits, where a torn fragment from a hard kill
+    (see ``EventLog._open``'s repair) is expected and the surviving record
+    stream is the point."""
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError as e:
+                if strict:
+                    raise ValueError(
+                        f"{path}:{lineno}: malformed event line: {e}"
+                    ) from e
+                import warnings
+
+                warnings.warn(f"{path}:{lineno}: skipping malformed event line: {e}")

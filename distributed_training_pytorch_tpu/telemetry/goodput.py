@@ -1,0 +1,132 @@
+"""Goodput accounting: wall time partitioned into named buckets.
+
+"Goodput" (MegaScale's per-run headline) is the fraction of a job's wall
+time spent making training progress. This meter partitions wall time into:
+
+* ``productive_step``   — executing (or draining) compiled train steps;
+* ``compile``           — XLA tracing/compilation (first window per shape);
+* ``data_wait``         — the step loop blocked on the input pipeline;
+* ``checkpoint``        — save/commit time the step loop actually waited on;
+* ``restart_rollback``  — resume overhead: checkpoint restore + replaying
+  the loader past already-trained batches after a preemption;
+* ``other``             — everything else (validation, logging, epoch glue).
+
+The partition is **exhaustive by construction**: the meter attributes the
+time between consecutive :meth:`tick` calls to exactly one bucket, so the
+bucket fractions always sum to 1 (the ``scripts/telemetry_smoke.py`` CI
+gate asserts it). Attribution is host-side wall time — with async dispatch
+the device's work surfaces wherever the host blocks (a sync point, or
+backpressure in the next data fetch), which is exactly the operator-visible
+cost each bucket names.
+
+Counters are **cumulative across restarts**: the trainer embeds
+:meth:`to_state` in every checkpoint's meta json (next to ``loop`` state)
+and re-seeds a resumed run's meter from it — goodput survives SIGTERM
+kill/resume the way ``loss_scale`` state survives via its checkpoint item.
+JSON round-trips Python floats exactly, so restored counters are
+bit-identical to the saved ones (test-enforced).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["BUCKETS", "GoodputMeter"]
+
+# Canonical bucket names, in reporting order. The meter accepts only these —
+# a typo'd bucket must fail loudly, not silently open a seventh bucket that
+# drains the fractions the smoke gate checks.
+BUCKETS = (
+    "productive_step",
+    "compile",
+    "data_wait",
+    "checkpoint",
+    "restart_rollback",
+    "other",
+)
+
+
+class GoodputMeter:
+    """Tick-based wall-time partitioner.
+
+    ``tick(bucket)`` attributes the time since the previous tick to
+    ``bucket`` and restarts the clock; the first tick (or the first after
+    :meth:`stop`) only starts the clock. ``account(bucket, seconds)`` adds
+    an externally measured duration (e.g. a checkpoint restore timed before
+    the loop starts).
+    """
+
+    def __init__(self, state: dict | None = None):
+        self.buckets: dict[str, float] = {b: 0.0 for b in BUCKETS}
+        if state:
+            self.load_state(state)
+        self._last: float | None = None
+
+    # -- time attribution --------------------------------------------------
+
+    def tick(self, bucket: str) -> float:
+        """Attribute elapsed-since-last-tick to ``bucket``; returns the
+        seconds attributed (0.0 on the starting tick)."""
+        if bucket not in self.buckets:
+            raise KeyError(f"unknown goodput bucket {bucket!r} (one of {BUCKETS})")
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return 0.0
+        dt = now - self._last
+        self._last = now
+        self.buckets[bucket] += dt
+        return dt
+
+    def account(self, bucket: str, seconds: float) -> None:
+        """Add an externally measured duration without touching the clock."""
+        if bucket not in self.buckets:
+            raise KeyError(f"unknown goodput bucket {bucket!r} (one of {BUCKETS})")
+        self.buckets[bucket] += float(seconds)
+
+    def start(self) -> None:
+        """Start (or restart) the clock without attributing anything."""
+        self._last = time.perf_counter()
+
+    def stop(self, bucket: str = "other") -> None:
+        """Close the open interval into ``bucket`` and stop the clock; the
+        next tick starts a fresh interval (a re-entered ``train()`` does not
+        absorb the idle gap between runs)."""
+        if self._last is not None:
+            self.tick(bucket)
+        self._last = None
+
+    # -- reporting ---------------------------------------------------------
+
+    def total(self) -> float:
+        return sum(self.buckets.values())
+
+    def fractions(self) -> dict[str, float]:
+        """Bucket fractions of total accounted wall time. Computed from the
+        same dict they partition, so they sum to 1 up to float rounding
+        (empty meter: all zeros)."""
+        total = self.total()
+        if total <= 0.0:
+            return {b: 0.0 for b in BUCKETS}
+        return {b: v / total for b, v in self.buckets.items()}
+
+    @property
+    def goodput(self) -> float:
+        """The headline: productive-step fraction of accounted wall time."""
+        return self.fractions()["productive_step"]
+
+    # -- checkpoint round trip ---------------------------------------------
+
+    def to_state(self) -> dict[str, float]:
+        """Plain-float snapshot for checkpoint meta (json-safe)."""
+        return {b: float(v) for b, v in self.buckets.items()}
+
+    def load_state(self, state: dict) -> None:
+        """Seed cumulative counters from a checkpoint snapshot. Unknown keys
+        (a future bucket rename) fold into ``other`` rather than being
+        dropped — the partition property must survive schema drift."""
+        for key, value in dict(state).items():
+            if key in self.buckets:
+                self.buckets[key] = float(value)
+            else:
+                self.buckets["other"] += float(value)

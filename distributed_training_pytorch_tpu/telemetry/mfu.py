@@ -1,0 +1,74 @@
+"""MFU and roofline fields from compiled-program cost analysis + step time.
+
+Model-FLOPs utilization (the PaLM-paper run metric) is FLOPs-per-second
+achieved over the chip's peak: ``flops_per_step / step_time / peak``. The
+FLOP numerator can come from three conventions (see ``bench.py``'s module
+doc): the analytic layer-formula count, the HLO conv/dot recount
+(``utils.hlo_flops.executed_matmul_flops``), or XLA's own
+``cost_analysis()``. This module owns the shared pieces — the per-chip peak
+table and the ratio — used by both ``bench.py`` (which assembles its three
+conventions with measurement-specific rescale guards) and the ``Trainer``'s
+telemetry (the ``TrainEngine.step_cost_analysis`` probe, reported per
+chained window via :func:`window_report`).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "PEAK_FLOPS",
+    "device_peak_flops",
+    "mfu_value",
+    "window_report",
+]
+
+# bf16 peak FLOP/s per chip, by PJRT device_kind substring (the table
+# bench.py's MFU headline has always used; "cpu" is a nominal stand-in so
+# smoke runs produce finite — clearly synthetic — utilization numbers).
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # v5e litepod chip (197 bf16 TFLOP/s)
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+    "cpu": 1e12,  # nominal, for smoke runs
+}
+
+
+def device_peak_flops(device) -> float:
+    """Peak bf16 FLOP/s of one device, by ``device_kind`` substring match
+    (1e12 nominal fallback for unknown kinds)."""
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 1e12
+
+
+def mfu_value(flops_per_step: float, step_time_s: float, peak_flops: float) -> float | None:
+    """``flops / dt / peak`` with the degenerate cases mapped to None (no
+    FLOPs known / zero time / zero peak -> no utilization claim)."""
+    if not flops_per_step or not step_time_s or not peak_flops:
+        return None
+    return float(flops_per_step) / float(step_time_s) / float(peak_flops)
+
+
+def window_report(
+    steps: int,
+    window_time_s: float,
+    *,
+    flops_per_step: float | None,
+    peak_flops: float,
+) -> dict:
+    """Per-window telemetry fields from measured wall time: ``steps``,
+    ``step_ms``, and ``mfu`` when a FLOP count is known (the trainer's
+    ``step_cost_analysis`` probe or an explicit ``Telemetry(flops_per_step=
+    ...)``). A "window" is whatever interval the caller timed — under
+    chained execution the trainer's sync points land on window boundaries,
+    so the report covers whole windows."""
+    steps = max(int(steps), 1)
+    step_s = window_time_s / steps
+    out = {"steps": steps, "step_ms": step_s * 1e3}
+    mfu = mfu_value(flops_per_step or 0.0, step_s, peak_flops)
+    if mfu is not None:
+        out["mfu"] = mfu
+    return out

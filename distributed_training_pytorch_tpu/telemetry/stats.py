@@ -1,0 +1,54 @@
+"""On-device train-health statistics — computed INSIDE the compiled step.
+
+The classic health panel (global grad norm, param norm, update-to-param
+ratio, non-finite flag) answers "is this run training?" without a debugger:
+a grad norm trending to zero is a dead graph, an update ratio far from the
+~1e-3 rule-of-thumb is a mis-tuned lr, a nonfinite flag is the first frame
+of a NaN post-mortem.
+
+The design constraint (the same one ``precision.loss_scale`` and the
+chained-window metrics obey): the statistics are computed inside
+``TrainEngine._train_step_impl`` and returned as ordinary metric entries —
+device scalars that ride the existing per-step metrics path, stack as scan
+outputs through chained windows, and reach the host only at the sync points
+the trainer already pays (``log_every`` / epoch end). **Zero extra host
+syncs, zero extra dispatches**; enabling them must not retrace the step more
+than its one trace per shape (``TrainEngine.trace_counts`` parity is
+test-enforced) nor perturb the update arithmetic (params stay bit-exact
+with a stats-off run — the norms read the dataflow, they are not in it).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+__all__ = ["STAT_KEYS", "train_health_stats"]
+
+# The metric keys stats mode adds (``nonfinite`` only when the engine's
+# unified non-finite guard has not already claimed the key with its exact
+# per-leaf predicate).
+STAT_KEYS = ("grad_norm", "param_norm", "update_ratio", "nonfinite")
+
+
+def train_health_stats(*, loss, grads, params, updates, eps: float = 1e-12) -> dict:
+    """Health scalars for one step, all on device.
+
+    * ``grad_norm``    — global L2 norm of the (unscaled, fp32) gradients;
+    * ``param_norm``   — global L2 norm of the pre-update master params;
+    * ``update_ratio`` — ||update|| / (||param|| + eps): the effective
+      relative step size (the lr-sanity number);
+    * ``nonfinite``    — 1.0 when the loss or any gradient went NaN/Inf.
+      Computed from the already-reduced ``grad_norm`` (any non-finite leaf
+      poisons the norm), so it adds no second pass over the gradient tree.
+    """
+    grad_norm = optax.global_norm(grads)
+    param_norm = optax.global_norm(params)
+    update_norm = optax.global_norm(updates)
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    return {
+        "grad_norm": grad_norm,
+        "param_norm": param_norm,
+        "update_ratio": update_norm / (param_norm + eps),
+        "nonfinite": 1.0 - finite.astype(jnp.float32),
+    }

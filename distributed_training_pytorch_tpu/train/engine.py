@@ -101,6 +101,7 @@ class TrainEngine:
         nan_guard: bool = False,
         precision=None,
         loss_scale=None,
+        stats: bool = False,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -119,6 +120,14 @@ class TrainEngine:
         # metrics["nonfinite"]=1. All inside the compiled step — no host
         # sync. Off by default: the where-select touches every state leaf.
         self.nan_guard = bool(nan_guard)
+        # Telemetry train-health stats (ISSUE 4): grad/param norms, update
+        # ratio, nonfinite flag computed INSIDE the step and returned as
+        # ordinary metrics — they ride chained windows as scan outputs with
+        # zero extra host syncs, and reading the dataflow (norm reductions
+        # hang off grads/params/updates, never feed back into them) keeps
+        # params bit-exact with a stats-off run. Off by default: the
+        # historical program traces byte-identically.
+        self.stats = bool(stats)
         self.sharding_rules = sharding_rules
         self.fsdp_min_size = fsdp_min_size
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
@@ -329,6 +338,20 @@ class TrainEngine:
         updates, new_opt_state = self.optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
+        if self.stats:
+            from distributed_training_pytorch_tpu.telemetry.stats import (
+                train_health_stats,
+            )
+
+            # setdefault: a user criterion that already reports one of these
+            # keys wins; the guard below overwrites `nonfinite` with its
+            # exact per-leaf predicate when armed (the stats flag derives
+            # from the reduced grad norm — same answer on real poison, but
+            # the guard's version is the skip-accounting source of truth).
+            for key, value in train_health_stats(
+                loss=loss, grads=grads, params=state.params, updates=updates
+            ).items():
+                metrics.setdefault(key, value)
         scale_state = state.loss_scale
         dynamic = is_dynamic(scale_state)
         if self.nan_guard or dynamic:
@@ -486,6 +509,33 @@ class TrainEngine:
         if compiler_options:
             return lowered.compile(compiler_options=dict(compiler_options))
         return lowered.compile()
+
+    def step_cost_analysis(self, state, batch) -> dict:
+        """XLA's cost analysis (FLOPs, bytes accessed, ...) of ONE train step
+        for these shapes — the telemetry MFU probe.
+
+        Compiles a telemetry-only copy of ``_train_step_impl`` (no counting
+        wrapper, no donation) on abstract avals: one extra off-hot-path XLA
+        compile, but the dispatch executables, their jit caches, and
+        ``trace_counts`` are untouched — the retrace-guard contract holds
+        with telemetry on (test-enforced). ``state``/``batch`` may be
+        concrete arrays or ``ShapeDtypeStruct`` trees (no data is read).
+        The scan conventions match ``utils.hlo_flops``: for a chained run
+        this single-step figure IS the per-step figure."""
+        state_sharding = self.state_sharding(state)
+        abstract_state, abstract_batch = jax.eval_shape(
+            lambda s, b: (s, b), state, batch
+        )
+        probe = jax.jit(
+            self._train_step_impl,
+            in_shardings=(state_sharding, self._batch_sharding),
+            out_shardings=(state_sharding, self._replicated),
+        )
+        with self._ambient_mesh():
+            compiled = probe.lower(abstract_state, abstract_batch).compile()
+        from distributed_training_pytorch_tpu.utils.hlo_flops import xla_cost_analysis
+
+        return xla_cost_analysis(compiled)
 
     def compile_chained_train_steps(
         self, state: TrainState, batch, length: int, *, compiler_options=None

@@ -71,6 +71,12 @@ from distributed_training_pytorch_tpu.precision import (
     is_dynamic,
     resolve_loss_scale,
 )
+from distributed_training_pytorch_tpu.telemetry import (
+    EventLog,
+    GoodputMeter,
+    resolve_telemetry,
+)
+from distributed_training_pytorch_tpu.telemetry import mfu as telemetry_mfu
 from distributed_training_pytorch_tpu.train import (
     NonFiniteLossError,
     TrainEngine,
@@ -121,6 +127,7 @@ class Trainer:
         fault_plan=None,
         precision=None,
         loss_scale=None,
+        telemetry=None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -276,6 +283,41 @@ class Trainer:
         self.world_size = self.mesh.devices.size
         self.local_batch_size = batch_size // jax.process_count()
 
+        # Telemetry subsystem (ISSUE 4; docs/observability.md): structured
+        # JSONL event log, goodput wall-time buckets, on-device train-health
+        # stats (threaded into the engine below), per-window MFU, and anomaly
+        # detectors. telemetry=None (default) is the historical program —
+        # self.events is a disabled no-op, self.goodput stays None, and the
+        # engine traces the exact pre-telemetry step.
+        self.telemetry = resolve_telemetry(telemetry)
+        if self.telemetry is not None:
+            self.events = EventLog(
+                self.telemetry.events_path
+                or os.path.join(save_folder, "telemetry", "events.jsonl")
+            )
+            self.goodput = GoodputMeter() if self.telemetry.goodput else None
+            self.anomaly_detector = self.telemetry.resolve_anomaly()
+            self._flops_per_step = self.telemetry.flops_per_step
+            self._peak_flops = (
+                telemetry_mfu.device_peak_flops(self.mesh.devices.flat[0])
+                * self.mesh.devices.size
+            )
+        else:
+            self.events = EventLog(None)
+            self.goodput = None
+            self.anomaly_detector = None
+            self._flops_per_step = None
+            self._peak_flops = 0.0
+        # MFU probe bookkeeping: the first executed batch's abstract shapes
+        # (ShapeDtypeStructs only — no device ops) feed the one-time
+        # engine.step_cost_analysis probe at the end of the first epoch.
+        self._mfu_probed = False
+        self._abstract_batch = None
+        self._last_step_ms = None
+        # Loss-scale backoff detection reads the per-step `loss_scale` metric
+        # at sync points (already host-fetched there — zero extra syncs).
+        self._last_scale_seen = None
+
         # Build hooks (``:38-41``) — model/criterion first, then datasets
         # (so ``build_scheduler`` can size per-epoch schedules from
         # ``len(self.train_dataset)`` without re-scanning), then
@@ -308,6 +350,7 @@ class Trainer:
             nan_guard=self.nan_policy in ("skip", "restore_last_good"),
             precision=self.precision,
             loss_scale=self._initial_loss_scale,
+            stats=self.telemetry.stats if self.telemetry is not None else False,
         )
 
         # State init (replaces model.to(device) + DDP param broadcast).
@@ -327,6 +370,7 @@ class Trainer:
             self.log("no checkpoint to resume (latest_valid) — starting fresh")
             snapshot_path = None
         if snapshot_path is not None:
+            t_restore = time.perf_counter()
             if snapshot_path == "latest_valid":
                 self.state, self.cur_epoch, snapshot_path = (
                     self.checkpoints.restore_latest_valid(self.state)
@@ -338,6 +382,24 @@ class Trainer:
             meta = self.checkpoints.read_meta(snapshot_path)
             self._resume_step_in_epoch = int(
                 (meta.get("loop") or {}).get("step_in_epoch", 0)
+            )
+            if self.goodput is not None:
+                # Cumulative goodput counters ride checkpoint meta (the way
+                # loss_scale state rides its checkpoint item): a resumed run
+                # continues the interrupted run's accounting bit-identically
+                # (json round-trips floats exactly — test-enforced), then
+                # books the restore itself as restart-rollback overhead.
+                saved = (meta.get("telemetry") or {}).get("goodput")
+                if saved:
+                    self.goodput.load_state(saved)
+                self.goodput.account(
+                    "restart_rollback", time.perf_counter() - t_restore
+                )
+            self.events.emit(
+                "checkpoint_restore",
+                name=os.path.basename(str(snapshot_path)),
+                epoch=self.cur_epoch,
+                step_in_epoch=self._resume_step_in_epoch,
             )
             self.log(
                 f"Resumed from {snapshot_path} at epoch {self.cur_epoch}"
@@ -390,6 +452,23 @@ class Trainer:
         """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
         self._install_sigterm()
         self.metrics_writer.reopen()  # symmetric with the close() below
+        if self.goodput is not None:
+            self.goodput.start()
+        if self.events.enabled:
+            # guarded like run_end: the field build includes an
+            # int(self.state.step) device fetch the telemetry-off
+            # (historical) path must not pay
+            self.events.emit(
+                "run_start",
+                epoch=self.cur_epoch,
+                max_epoch=self.max_epoch,
+                step=int(self.state.step),
+                resumed_step_in_epoch=self._resume_step_in_epoch,
+                processes=jax.process_count(),
+                devices=self.world_size,
+                chain_steps=self.chain_steps,
+                compute_dtype=str(jnp.dtype(self.precision.compute_dtype)),
+            )
         try:
             self._train_loop()
         finally:
@@ -399,6 +478,23 @@ class Trainer:
             # protected again. The metrics writer closes here too so the
             # preemption early-return and error paths flush it.
             self._restore_sigterm()
+            if self.goodput is not None:
+                self.goodput.stop()
+            if self.events.enabled:
+                fields = {
+                    "step": int(self.state.step),
+                    "epoch": self.cur_epoch,
+                    "preempted": self._preempted,
+                    "nonfinite_steps": self.nonfinite_steps,
+                }
+                if self.goodput is not None:
+                    fields["goodput"] = self.goodput.goodput
+                    fields["goodput_seconds"] = self.goodput.to_state()
+                    fields["goodput_fractions"] = self.goodput.fractions()
+                if self.anomaly_detector is not None:
+                    fields["anomalies"] = self.anomaly_detector.total_fired
+                self.events.emit("run_end", **fields)
+            self.events.close()  # a re-entered train() lazily reopens (append)
             self.metrics_writer.close()
 
     def _train_loop(self) -> None:
@@ -411,7 +507,9 @@ class Trainer:
             # best stores label `epoch`, deliberate parity with §2e).
             if self.have_validate and self.save_period and epoch % self.save_period == 0:
                 metrics = self.validate()
-                if self.checkpoints.maybe_save_best(metrics, self.state, epoch):
+                if self._save_checkpoint(
+                    BEST, epoch, reason="best", metrics=metrics, best=True
+                ):
                     best_banner = {"epoch": epoch, "metrics": dict(metrics)}
                 if best_banner is not None:
                     self.log(100 * "=")
@@ -444,10 +542,18 @@ class Trainer:
                     if self._epoch_interrupted
                     else None
                 )
-                self.checkpoints.save(
-                    LAST, self.state, resume_epoch, loop_state=loop_state
+                self.events.emit(
+                    "preemption",
+                    epoch=epoch,
+                    resume_epoch=resume_epoch,
+                    step_in_epoch=self._interrupted_at_step
+                    if self._epoch_interrupted
+                    else 0,
                 )
-                self.checkpoints.wait()
+                self._save_checkpoint(
+                    LAST, resume_epoch, loop_state=loop_state, wait=True,
+                    reason="preemption",
+                )
                 self.log(
                     f"SIGTERM received — saved resumable snapshot (epoch "
                     f"{resume_epoch}"
@@ -469,12 +575,10 @@ class Trainer:
             # epoch+1 = the next epoch to train on resume (``:165-167``).
             if self.have_validate:
                 if (epoch + 1) % self.last_save_period == 0 or epoch + 1 == self.max_epoch:
-                    self.checkpoints.save(LAST, self.state, epoch + 1)
+                    self._save_checkpoint(LAST, epoch + 1)
                     self.log(f"Saved model at epoch {epoch + 1}!")
             elif self.save_period and epoch % self.save_period == 0:
-                self.checkpoints.save(
-                    epoch_checkpoint_name(epoch + 1), self.state, epoch + 1
-                )
+                self._save_checkpoint(epoch_checkpoint_name(epoch + 1), epoch + 1)
                 self.log(f"Saved model at epoch {epoch + 1}!")
 
             # Epoch loss report — *global* means (pmean'd inside the step),
@@ -485,6 +589,7 @@ class Trainer:
             self.log(msg)
             self.metrics_writer.write(int(self.state.step), epoch_metrics, prefix="train")
             self._write_precision_scalars()
+            self._write_telemetry_scalars()
 
         self.checkpoints.wait()
         self.log("Finished!")
@@ -513,6 +618,152 @@ class Trainer:
             },
             prefix="precision",
         )
+
+    # ------------------------------------------------------------------
+    # Telemetry (ISSUE 4; docs/observability.md). Everything here is a
+    # no-op / zero-overhead path when telemetry is off, and never a reason
+    # training dies (the MFU probe degrades to a warning on failure).
+    # ------------------------------------------------------------------
+
+    def _telemetry_meta(self) -> dict | None:
+        """Cumulative telemetry counters for checkpoint meta — currently the
+        goodput buckets, so goodput accounting survives kill/resume."""
+        if self.goodput is None:
+            return None
+        return {"goodput": self.goodput.to_state()}
+
+    def _save_checkpoint(
+        self,
+        name: str,
+        epoch: int,
+        *,
+        loop_state: Mapping | None = None,
+        wait: bool = False,
+        reason: str = "epoch",
+        metrics: Mapping | None = None,
+        best: bool = False,
+    ) -> bool:
+        """Checkpoint save + telemetry, one implementation for every trainer
+        save site (last / periodic / preemption / best): goodput counters
+        into the meta, save (+ optional commit wait) attributed to the
+        ``checkpoint`` bucket, and a ``checkpoint_save`` event.
+
+        ``best=True`` routes through the manager's best-fitness rule
+        (``maybe_save_best``); returns whether a checkpoint was written."""
+        if self.goodput is not None:
+            self.goodput.tick("other")  # close the epoch-glue interval
+        if best:
+            saved = self.checkpoints.maybe_save_best(
+                metrics, self.state, epoch, telemetry=self._telemetry_meta()
+            )
+        else:
+            self.checkpoints.save(
+                name, self.state, epoch, metrics=metrics, loop_state=loop_state,
+                telemetry=self._telemetry_meta(),
+            )
+            saved = True
+        if wait:
+            self.checkpoints.wait()
+        if self.goodput is not None:
+            self.goodput.tick("checkpoint" if saved else "other")
+        if saved:
+            fields = {"name": name, "epoch": epoch, "reason": reason}
+            if loop_state:
+                fields["step_in_epoch"] = int(loop_state.get("step_in_epoch", 0))
+            self.events.emit("checkpoint_save", **fields)
+        return saved
+
+    def _write_telemetry_scalars(self) -> None:
+        """TensorBoard: goodput fractions + per-step wall time / MFU next to
+        the train scalars (process 0 only; no-op without tensorboardX —
+        the MetricsWriter contract). The on-device health stats need no
+        writer of their own: they are ordinary train metrics."""
+        if self.telemetry is None:
+            return
+        step = int(self.state.step)
+        if self.goodput is not None:
+            self.metrics_writer.write(step, self.goodput.fractions(), prefix="goodput")
+        if self._last_step_ms is not None:
+            scalars = {"step_ms": self._last_step_ms}
+            mfu = telemetry_mfu.mfu_value(
+                self._flops_per_step or 0.0, self._last_step_ms / 1e3, self._peak_flops
+            )
+            if mfu is not None:
+                scalars["mfu"] = mfu
+            self.metrics_writer.write(step, scalars, prefix="telemetry")
+
+    def _maybe_probe_mfu(self) -> None:
+        """One-time XLA cost-analysis probe for the per-step FLOP count
+        (``TrainEngine.step_cost_analysis``): one extra off-hot-path compile
+        that never touches the dispatch executables or ``trace_counts``.
+        Runs at the end of the first trained epoch (shapes known by then);
+        skipped when an analytic ``Telemetry(flops_per_step=...)`` was given,
+        when MFU is off, or when a custom ``train_step`` override means the
+        engine's step is not the one actually running."""
+        if (
+            self.telemetry is None
+            or not self.telemetry.mfu
+            or self._mfu_probed
+            or self._flops_per_step is not None
+            or self._abstract_batch is None
+            or type(self).train_step is not Trainer.train_step
+        ):
+            return
+        self._mfu_probed = True
+        if self.engine.accum_steps > 1:
+            # XLA's cost_analysis may count the grad-accumulation scan BODY
+            # once (~accum x undercount — bench.py rescales against its
+            # analytic anchor; the trainer has none, and a silently-wrong
+            # MFU is worse than no MFU). Probe disabled: pass the analytic
+            # count via Telemetry(flops_per_step=...) instead.
+            self.log(
+                "telemetry: MFU probe skipped under grad accumulation "
+                f"(accum_steps={self.engine.accum_steps}) — XLA may count the "
+                "microbatch scan body once; pass Telemetry(flops_per_step=...) "
+                "for MFU reporting",
+                "warning",
+            )
+            return
+        t0 = time.perf_counter()
+        try:
+            cost = self.engine.step_cost_analysis(self.state, self._abstract_batch)
+        except Exception as e:  # noqa: BLE001 — telemetry must never kill a run
+            self.log(
+                f"telemetry: MFU probe failed ({e}) — per-window MFU disabled",
+                "warning",
+            )
+            return
+        dt = time.perf_counter() - t0
+        if self.goodput is not None:
+            self.goodput.tick("compile")  # the probe IS an XLA compile
+        self._flops_per_step = float(cost.get("flops", 0.0)) or None
+        self.events.emit(
+            "compile",
+            kind="mfu_probe",
+            seconds=dt,
+            flops_per_step=self._flops_per_step,
+        )
+
+    def _report_anomalies(self, anomalies, *, epoch=None, step_in_epoch=None) -> None:
+        """Emit + log each finding; raise when the detector was built with
+        ``action="raise"`` (the observability analog of nan_policy='raise')."""
+        if not anomalies:
+            return
+        for a in anomalies:
+            self.events.emit(
+                "anomaly",
+                kind=a.kind,
+                value=a.value,
+                baseline=a.baseline,
+                factor=a.factor,
+                epoch=epoch,
+                step_in_epoch=step_in_epoch,
+            )
+            self.log(f"telemetry anomaly: {a.describe()}", "warning")
+        if self.anomaly_detector.action == "raise":
+            from distributed_training_pytorch_tpu.telemetry import AnomalyError
+
+            raise AnomalyError("; ".join(a.describe() for a in anomalies))
 
     def _validate_chain_config(self) -> None:
         """Reject/round knob combinations that would silently misalign with
@@ -623,6 +874,18 @@ class Trainer:
         synced_entries = 0  # index into `collected` of the last nan-policy sync
         synced_steps = 0  # the same sync position, in steps
         t0 = time.perf_counter()
+        # Telemetry (no-ops when off): goodput attributes the epoch's wall
+        # time to buckets at the loop's existing boundaries — no added device
+        # syncs anywhere in this method; tele_sync anchors per-window step
+        # timing at the log_every host syncs.
+        tm = self.goodput
+        if tm is not None:
+            tm.tick("other")  # close the epoch preamble (validation/log glue)
+        # The first fetch after a mid-epoch resume replays the loader past
+        # the already-trained batches — restart-rollback cost, not data_wait.
+        rollback_fetch = skip_steps > 0
+        tele_sync = [t0, 0]  # (perf_counter, executed) at the last sync point
+        trace_base = [0]  # trace_counts total before the in-flight unit
         num_batches = len(self.train_dataloader)
         chain = self.chain_steps
         # Resume skip happens at the loader's INDEX level when it can
@@ -695,10 +958,90 @@ class Trainer:
             self.log(f"  step {step_in_epoch}/{num_batches} {m} ({rate:.1f} img/s)")
             if bar is not None:
                 bar.refresh()
+            if self.telemetry is not None:
+                # Per-window telemetry on the back of this host sync (the
+                # float() fetches above) — step timing/MFU event, loss-scale
+                # backoff detection, anomaly detectors. Zero extra syncs.
+                now = time.perf_counter()
+                window_steps = executed - tele_sync[1]
+                window_s = now - tele_sync[0]
+                tele_sync[0], tele_sync[1] = now, executed
+                if window_steps > 0:
+                    report = telemetry_mfu.window_report(
+                        window_steps,
+                        window_s,
+                        flops_per_step=self._flops_per_step,
+                        peak_flops=self._peak_flops,
+                    )
+                    self._last_step_ms = report["step_ms"]
+                    self.events.emit(
+                        "window", epoch=epoch, step_in_epoch=step_in_epoch, **report
+                    )
+                    scale = m.get("loss_scale")
+                    if scale is not None:
+                        if (
+                            self._last_scale_seen is not None
+                            and scale < self._last_scale_seen
+                        ):
+                            self.events.emit(
+                                "loss_scale_backoff",
+                                epoch=epoch,
+                                step_in_epoch=step_in_epoch,
+                                from_scale=self._last_scale_seen,
+                                to_scale=scale,
+                            )
+                        self._last_scale_seen = scale
+                    if self.anomaly_detector is not None:
+                        self._report_anomalies(
+                            self.anomaly_detector.observe(
+                                step_in_epoch,
+                                loss=m.get("loss", m.get("ce_loss")),
+                                grad_norm=m.get("grad_norm"),
+                                step_time=report["step_ms"] / 1e3,
+                            ),
+                            epoch=epoch,
+                            step_in_epoch=step_in_epoch,
+                        )
+
+        def tick_unit():
+            # Attribute the just-executed unit's wall time: a unit whose
+            # dispatch traced a new executable paid XLA compile (jit compiles
+            # synchronously inside the call) — the compile bucket; every
+            # cache-hit unit is productive step time.
+            if self.telemetry is None:
+                return
+            traced = sum(self.engine.trace_counts.values()) - trace_base[0]
+            if tm is not None:
+                tm.tick("compile" if traced else "productive_step")
+            if traced:
+                self.events.emit(
+                    "compile",
+                    epoch=epoch,
+                    step_in_epoch=step_in_epoch,
+                    executables=traced,
+                )
 
         try:
             interrupted = False
             for n, batch in units:
+                # First tick of the body: everything since the previous
+                # unit's tick is the for statement's implicit next() — the
+                # input pipeline wait.
+                if tm is not None:
+                    tm.tick("restart_rollback" if rollback_fetch else "data_wait")
+                rollback_fetch = False
+                if self.telemetry is not None:
+                    trace_base[0] = sum(self.engine.trace_counts.values())
+                    if self._abstract_batch is None:
+                        # Shapes only (ShapeDtypeStructs, no device ops):
+                        # feeds the one-time MFU probe at epoch end. A window
+                        # leaf [n, B, ...] strips its leading step axis.
+                        self._abstract_batch = jax.tree.map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape if n == 1 else x.shape[1:], x.dtype
+                            ),
+                            batch,
+                        )
                 if n > 1 and not self._fault_active_in_window(
                     epoch, step_in_epoch, step_in_epoch + n
                 ):
@@ -722,6 +1065,7 @@ class Trainer:
                         bar.update(n)
                     if self.log_every and step_in_epoch % self.log_every == 0:
                         sync_log_point()
+                    tick_unit()
                     continue
                 # -- single-step path: lead/tail units, chain_steps == 1, and
                 # windows with pending fault injections (unstacked so the
@@ -753,6 +1097,7 @@ class Trainer:
                         bar.update(1)
                     if self.log_every and step_in_epoch % self.log_every == 0:
                         sync_log_point()
+                tick_unit()
                 if interrupted:
                     break
             if interrupted:
@@ -776,7 +1121,50 @@ class Trainer:
                 host.extend(
                     {key: v[i] for key, v in tree.items()} for i in range(k)
                 )
-        return self._aggregate_epoch_metrics(host, synced_steps)
+        if tm is not None:
+            # The device_get above drained every in-flight step — that wait
+            # is device execution, i.e. productive time.
+            tm.tick("productive_step")
+        # Epoch wall time is closed BEFORE the MFU probe: the probe's one-time
+        # XLA compile (seconds to minutes on a real model) must not inflate
+        # this epoch's step_ms/MFU report — a first-epoch step-time figure
+        # 2.5x the window baseline would fire a spurious step_time_regression.
+        epoch_wall = time.perf_counter() - t0
+        self._maybe_probe_mfu()  # one-time; attributes itself to `compile`
+        out = self._aggregate_epoch_metrics(host, synced_steps)
+        if self.telemetry is not None and executed:
+            report = telemetry_mfu.window_report(
+                executed,
+                epoch_wall,
+                flops_per_step=self._flops_per_step,
+                peak_flops=self._peak_flops,
+            )
+            self._last_step_ms = report["step_ms"]
+            health = {
+                k: out[k]
+                for k in ("loss", "ce_loss", "grad_norm", "update_ratio", "nonfinite")
+                if k in out
+            }
+            self.events.emit(
+                "epoch_end",
+                epoch=epoch,
+                wall_s=epoch_wall,
+                interrupted=self._epoch_interrupted,
+                **report,
+                **health,
+            )
+            if self.anomaly_detector is not None:
+                self._report_anomalies(
+                    self.anomaly_detector.observe(
+                        step_in_epoch,
+                        loss=out.get("loss", out.get("ce_loss")),
+                        grad_norm=out.get("grad_norm"),
+                        step_time=report["step_ms"] / 1e3,
+                    ),
+                    epoch=epoch,
+                    step_in_epoch=step_in_epoch,
+                )
+        return out
 
     def _aggregate_epoch_metrics(self, host: list[dict], synced: int = 0) -> dict:
         """Per-epoch means. Under the non-finite guard, poisoned steps are
@@ -845,7 +1233,10 @@ class Trainer:
 
     def _inject_step_faults(self, batch, epoch: int, step: int):
         """Deterministic fault-injection points (fault/inject.py): a real
-        SIGTERM, a simulated hung step, or a NaN-poisoned batch."""
+        SIGTERM, a simulated hung step, or a NaN-poisoned batch. Every
+        firing lands in the telemetry event log (rank-0, no-op when off) so
+        a test run's flight record shows exactly which faults fired where."""
+        fired_before = len(self.fault_plan.fired)
         self.fault_plan.maybe_sigterm(epoch=epoch, step=step)
         hang = self.fault_plan.fires("hang", epoch=epoch, step=step)
         if hang is not None:
@@ -857,6 +1248,9 @@ class Trainer:
                 else x,
                 batch,
             )
+        if self.events.enabled:
+            for kind, ctx in self.fault_plan.fired[fired_before:]:
+                self.events.emit("fault_injection", kind=kind, **ctx)
         return batch
 
     _hung_once = False
@@ -886,6 +1280,7 @@ class Trainer:
             "preemption-style resumable save",
             "warning",
         )
+        self.events.emit("hung_step", timeout_s=timeout)
         os.kill(os.getpid(), signal.SIGTERM)
 
     def _on_preemption_signal(self, signum, frame) -> None:

@@ -13,9 +13,11 @@ reduced) so one writer sees everything.
 
 from __future__ import annotations
 
+import math
 from typing import Mapping
 
 import jax
+import numpy as np
 
 __all__ = ["MetricsWriter"]
 
@@ -45,15 +47,26 @@ class MetricsWriter:
         return self._writer is not None
 
     def write(self, step: int, metrics: Mapping, prefix: str = "") -> None:
+        """Write one scalar per finite entry. Values are coerced ONCE here —
+        python/numpy/jax scalars and 0-d (or 1-element) arrays all become a
+        plain float before touching the backend, so ``add_scalar`` never sees
+        a device array or a numpy dtype it would re-coerce per call. Entries
+        that are not scalar, or not finite (a NaN epoch loss under
+        ``nan_policy``, an Inf ``update_ratio`` on a poisoned step), are
+        skipped: a bad value must cost one missing curve point, never the
+        writer (and with it every later scalar of the run)."""
         if self._writer is None:
             return
+        step = int(step)
         for key, value in metrics.items():
             try:
-                value = float(value)
+                value = float(np.asarray(value).reshape(()))
             except (TypeError, ValueError):
                 continue  # non-scalar entries are not TensorBoard material
+            if not math.isfinite(value):
+                continue  # tolerate NaN/Inf: skip the point, keep the writer
             tag = f"{prefix}/{key}" if prefix else key
-            self._writer.add_scalar(tag, value, int(step))
+            self._writer.add_scalar(tag, value, step)
         self._writer.flush()
 
     def close(self) -> None:

@@ -22,7 +22,8 @@ dataset-appropriate, exactly as the reference's own pipeline is tuned to its
 Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``EPOCHS`` (default 150),
 ``BATCH`` (global, default 128), ``DIGITS_LR``, ``SAVE_DIR`` (default
 ./runs/digits), ``DTYPE`` (fp32|bf16|fp16 mixed-precision policy, default
-fp32 — docs/mixed_precision.md).
+fp32 — docs/mixed_precision.md), ``TELEMETRY`` (1 = event log + goodput +
+train-health stats + MFU — docs/observability.md).
 """
 
 from __future__ import annotations
@@ -127,6 +128,10 @@ if __name__ == "__main__":
         # the model's activation dtype follows via ExampleTrainer.build_model
         # (docs/mixed_precision.md). Default fp32 = reference parity.
         precision=os.environ.get("DTYPE") or None,
+        # TELEMETRY=1 (mirrors DTYPE/CHAIN_STEPS): events JSONL under
+        # SAVE_DIR/telemetry, goodput buckets, on-device train-health stats,
+        # per-window MFU (docs/observability.md). Unset = historical program.
+        telemetry=os.environ.get("TELEMETRY") == "1" or None,
         have_validate=True,
         save_best_for=("accuracy", "geq"),
         save_period=int(os.environ.get("SAVE_PERIOD", "25")),

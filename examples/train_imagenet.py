@@ -242,6 +242,9 @@ if __name__ == "__main__":
         max_epoch=int(os.environ.get("EPOCHS", "90")),
         batch_size=int(os.environ.get("BATCH", "1024")),
         chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # TELEMETRY=1 (mirrors DTYPE/CHAIN_STEPS): telemetry subsystem —
+        # docs/observability.md. Unset = historical program.
+        telemetry=os.environ.get("TELEMETRY") == "1" or None,
         accum_steps=int(os.environ.get("ACCUM", str(recipe["accum"]))),
         have_validate=True,
         save_best_for=("accuracy", "geq"),

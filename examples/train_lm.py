@@ -166,6 +166,9 @@ if __name__ == "__main__":
         max_epoch=int(os.environ.get("EPOCHS", "10")),
         batch_size=int(os.environ.get("BATCH", "256")),
         chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # TELEMETRY=1 (mirrors DTYPE/CHAIN_STEPS): telemetry subsystem —
+        # docs/observability.md. Unset = historical program.
+        telemetry=os.environ.get("TELEMETRY") == "1" or None,
         have_validate=True,
         save_best_for=("nll", "leq"),
         save_period=int(os.environ.get("SAVE_PERIOD", "1")),

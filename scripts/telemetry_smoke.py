@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Telemetry smoke — observability CI gate (ISSUE 4 satellite).
+
+Trains a small classifier on the REAL sklearn digits corpus (the offline
+stand-in every accuracy clause uses) for a couple of epochs with
+``telemetry="on"`` and ``chain_steps=2`` (windows + the health stats riding
+scan outputs), then asserts the subsystem's core contracts:
+
+* the event log is **well-formed JSONL**: every line parses, every record
+  carries the schema fields (event, t_wall, t_mono, process, host), the
+  ``t_mono`` stream is nondecreasing, and the run's narrative events
+  (run_start, epoch_end, checkpoint_save, run_end) are all present;
+* **goodput bucket fractions sum to 1 ± ε** and the run actually spent time
+  compiling and stepping (a partition that silently lost a bucket would
+  fail here in seconds, not as a nonsense dashboard on real hardware);
+* the on-device **train-health stats** came back through the epoch metrics
+  (grad_norm / param_norm / update_ratio finite, nonfinite == 0) without
+  disturbing the retrace contract (chained executable traced exactly once).
+
+Fails fast (nonzero exit) so ``scripts/verify.sh`` catches observability
+regressions the way the retrace/precision gates catch theirs.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.data import ArrayDataSource
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.telemetry import read_events
+from distributed_training_pytorch_tpu.trainer import Trainer
+
+
+class DigitsNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+class SmokeTrainer(Trainer):
+    def build_train_dataset(self):
+        from sklearn.datasets import load_digits
+
+        digits = load_digits()
+        images = (digits.images / 16.0).astype(np.float32)[..., None]
+        return ArrayDataSource(image=images, label=digits.target.astype(np.int32))
+
+    def build_model(self):
+        return DigitsNet()
+
+    def build_criterion(self):
+        def criterion(logits, batch):
+            loss = cross_entropy_loss(logits, batch["label"])
+            return loss, {"loss": loss}
+
+        return criterion
+
+    def build_optimizer(self, schedule):
+        return optax.sgd(schedule, momentum=0.9)
+
+    def build_scheduler(self):
+        return 0.1
+
+
+REQUIRED_FIELDS = ("event", "t_wall", "t_mono", "process", "host")
+REQUIRED_EVENTS = ("run_start", "window", "epoch_end", "checkpoint_save", "run_end")
+STAT_KEYS = ("grad_norm", "param_norm", "update_ratio", "nonfinite")
+
+
+def main() -> int:
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    epoch_metrics = []
+
+    class Recorder(SmokeTrainer):
+        def train_epoch(self, epoch):
+            m = super().train_epoch(epoch)
+            epoch_metrics.append(m)
+            return m
+
+    try:
+        trainer = Recorder(
+            max_epoch=2,
+            batch_size=128,
+            save_folder=tmp,
+            telemetry="on",
+            chain_steps=2,
+            log_every=4,
+            num_workers=0,
+            async_checkpoint=False,
+            progress=False,
+            # no validation -> the periodic checkpoint branch saves
+            have_validate=False,
+            save_period=1,
+            logger=type("Q", (), {"log": staticmethod(lambda *a, **k: None)})(),
+        )
+        trainer.train()
+
+        errors = []
+
+        # -- event log: well-formed JSONL with the full narrative ----------
+        # read via the shipped consumer (telemetry.read_events) so the gate
+        # exercises the same parse path tests and tooling use
+        path = os.path.join(tmp, "telemetry", "events.jsonl")
+        events = []
+        if not os.path.isfile(path):
+            errors.append(f"no event log at {path}")
+        else:
+            try:
+                events = list(read_events(path))
+            except ValueError as e:
+                errors.append(str(e))
+        for rec in events:
+            missing = [k for k in REQUIRED_FIELDS if k not in rec]
+            if missing:
+                errors.append(f"event {rec.get('event')!r} missing fields {missing}")
+                break
+        mono = [rec["t_mono"] for rec in events if "t_mono" in rec]
+        if mono != sorted(mono):
+            errors.append("t_mono stream is not nondecreasing")
+        kinds = {rec.get("event") for rec in events}
+        for required in REQUIRED_EVENTS:
+            if required not in kinds:
+                errors.append(f"missing {required!r} event (saw {sorted(kinds)})")
+
+        # -- goodput: exhaustive partition, real compile + step time -------
+        fractions = trainer.goodput.fractions()
+        total = sum(fractions.values())
+        if abs(total - 1.0) > 1e-6:
+            errors.append(f"goodput fractions sum to {total!r}, not 1: {fractions}")
+        if not trainer.goodput.buckets["compile"] > 0:
+            errors.append(f"no compile time accounted: {trainer.goodput.buckets}")
+        if not trainer.goodput.buckets["productive_step"] > 0:
+            errors.append(f"no productive time accounted: {trainer.goodput.buckets}")
+
+        # -- on-device health stats rode the chained windows ---------------
+        for key in STAT_KEYS:
+            if key not in epoch_metrics[-1]:
+                errors.append(f"epoch metrics missing stat {key!r}: {epoch_metrics[-1]}")
+            elif not np.isfinite(epoch_metrics[-1][key]):
+                errors.append(f"stat {key!r} not finite: {epoch_metrics[-1][key]}")
+        if epoch_metrics[-1].get("nonfinite"):
+            errors.append(f"clean run reported nonfinite steps: {epoch_metrics[-1]}")
+        if trainer.engine.trace_counts["chained_2"] != 1:
+            errors.append(
+                f"chained executable retraced with telemetry on: "
+                f"{dict(trainer.engine.trace_counts)}"
+            )
+
+        if errors:
+            print("TELEMETRY SMOKE FAILED:", file=sys.stderr)
+            for e in errors:
+                print(f"  - {e}", file=sys.stderr)
+            return 1
+        print(
+            f"telemetry smoke OK: {len(events)} events, goodput "
+            f"{trainer.goodput.goodput:.2f} productive "
+            f"(compile {fractions['compile']:.2f}), "
+            f"grad_norm {epoch_metrics[-1]['grad_norm']:.3f}"
+        )
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

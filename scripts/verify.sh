@@ -17,12 +17,16 @@
 # decreases, no steps are skipped, compute runs in bf16, and master weights
 # stay fp32 — precision regressions fail fast like retrace regressions.
 #
-# Stage 4 is the ROADMAP.md tier-1 command verbatim.
+# Stage 4 is a short CPU digits run with telemetry="on" asserting the event
+# log is well-formed JSONL, goodput bucket fractions sum to 1 +- eps, and the
+# on-device health stats rode the chained windows without a retrace.
+#
+# Stage 5 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/4: import health (pytest --collect-only) =="
+echo "== stage 1/5: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -31,19 +35,25 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/4: chained-dispatch retrace guard =="
+echo "== stage 2/5: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 3
 fi
 
-echo "== stage 3/4: mixed-precision smoke (bf16 digits) =="
+echo "== stage 3/5: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 4
 fi
 
-echo "== stage 4/4: tier-1 test suite =="
+echo "== stage 4/5: telemetry smoke (event log + goodput + stats) =="
+if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
+  echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
+  exit 5
+fi
+
+echo "== stage 5/5: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -122,6 +122,13 @@ class CheckpointManager:
         self.save_retries = int(save_retries)
         self.retry_backoff = float(retry_backoff)
         self.fault_plan = fault_plan
+        # Optional telemetry EventLog (duck-typed: anything with .emit).
+        # restore_latest_valid reports each checkpoint it rejects while
+        # scanning backward through it, so recovery skips land in the JSONL
+        # flight record instead of only in free-text logger lines. The
+        # trainer assigns it after constructing its event log; None (the
+        # default) keeps the manager telemetry-free.
+        self.event_log = None
         self._best_value: float | None = None
         self._staging_seq = 0
         # (staging_path, final_name, composite_args) of the in-flight save;
@@ -414,13 +421,11 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
 
-    def maybe_save_best(
-        self, metrics: Mapping, state: Any, epoch: int, telemetry: Mapping | None = None
-    ) -> bool:
-        """Apply the best-fitness rule; save under ``best`` on improvement.
-
-        Returns True when a new best was saved (``trainer/trainer.py:118-130``).
-        """
+    def best_improved(self, metrics: Mapping) -> bool:
+        """Apply the best-fitness rule and record a new best value — WITHOUT
+        saving. Split from :meth:`maybe_save_best` so the async save path
+        (``resilience.AsyncCheckpointSaver.maybe_save_best``) can evaluate
+        the rule on-thread and route the save through its own queue."""
         if self.save_best_for is None:
             return False
         metric, mode = self.save_best_for
@@ -436,8 +441,19 @@ class CheckpointManager:
         )
         if improved:
             self._best_value = value
-            self.save(BEST, state, epoch, metrics=metrics, telemetry=telemetry)
         return improved
+
+    def maybe_save_best(
+        self, metrics: Mapping, state: Any, epoch: int, telemetry: Mapping | None = None
+    ) -> bool:
+        """Apply the best-fitness rule; save under ``best`` on improvement.
+
+        Returns True when a new best was saved (``trainer/trainer.py:118-130``).
+        """
+        if not self.best_improved(metrics):
+            return False
+        self.save(BEST, state, epoch, metrics=metrics, telemetry=telemetry)
+        return True
 
     # -- integrity ---------------------------------------------------------
 
@@ -628,8 +644,18 @@ class CheckpointManager:
         self.wait()
         skipped = []
         for name in self.checkpoint_names():
-            if not self.is_valid(name):
+            try:
+                self.validate(name)
+            except (CorruptCheckpointError, FileNotFoundError, ValueError) as e:
                 skipped.append(name)
+                if self.event_log is not None:
+                    # Recovery skips become flight-record facts (ISSUE 5):
+                    # a torn preemption save silently degrading the resume
+                    # to an older snapshot is visible in the JSONL log, not
+                    # only in logger text.
+                    self.event_log.emit(
+                        "checkpoint_rejected", name=name, reason=str(e)
+                    )
                 continue
             # validate=False: is_valid just hashed every file; re-validating
             # inside restore would double the resume path's disk reads.

@@ -1,0 +1,22 @@
+"""Resilience layer (ISSUE 5): asynchronous + emergency checkpointing.
+
+Sits between the trainer's save sites and the crash-consistent
+:class:`~distributed_training_pytorch_tpu.checkpoint.manager.
+CheckpointManager`: a save becomes a millisecond device->host snapshot on
+the hot loop plus a background-thread commit through the existing staging +
+manifest + atomic-rename machinery, with a newest-wins bounded queue, a
+``flush()`` barrier, and a synchronous *emergency* path for SIGTERM /
+watchdog saves that must land inside the preemption grace window.
+
+``scripts/chaos_soak.py`` is the subsystem's proof: randomized seeded
+SIGTERM/SIGKILL kills (including mid-background-commit) with verified
+bit-exact resume. See docs/fault_tolerance.md for the save state machine.
+"""
+
+from distributed_training_pytorch_tpu.resilience.async_saver import (  # noqa: F401
+    AsyncCheckpointSaver,
+    SaveRequest,
+    measure_save_stall,
+)
+
+__all__ = ["AsyncCheckpointSaver", "SaveRequest", "measure_save_stall"]

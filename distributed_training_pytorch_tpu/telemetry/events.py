@@ -34,6 +34,7 @@ import json
 import math
 import os
 import socket
+import threading
 import time
 from typing import Any, Iterator
 
@@ -82,6 +83,11 @@ class EventLog:
         self.process = proc
         self.enabled = path is not None and proc == 0
         self._host = socket.gethostname()
+        # Emits may come from the async-checkpoint commit worker as well as
+        # the main thread; timestamping AND writing under one lock keeps the
+        # file's t_mono stream nondecreasing (two threads reading the clock
+        # then writing in the other order would interleave otherwise).
+        self._emit_lock = threading.Lock()
 
     def _open(self):
         if self._file is None:
@@ -107,27 +113,28 @@ class EventLog:
         disabled). Field values are coerced to JSON-safe scalars."""
         if not self.enabled or self._dead:
             return None
-        record = {
-            "event": str(event),
-            "t_wall": time.time(),
-            "t_mono": time.monotonic(),
-            "process": self.process,
-            "host": self._host,
-            "pid": os.getpid(),
-        }
-        for key, value in fields.items():
-            record[str(key)] = _jsonable(value)
-        try:
-            f = self._open()
-            f.write(json.dumps(record) + "\n")
-            f.flush()
-        except OSError as e:
-            # Telemetry must never kill training: disable and move on.
-            self._dead = True
-            import warnings
+        with self._emit_lock:
+            record = {
+                "event": str(event),
+                "t_wall": time.time(),
+                "t_mono": time.monotonic(),
+                "process": self.process,
+                "host": self._host,
+                "pid": os.getpid(),
+            }
+            for key, value in fields.items():
+                record[str(key)] = _jsonable(value)
+            try:
+                f = self._open()
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+            except OSError as e:
+                # Telemetry must never kill training: disable and move on.
+                self._dead = True
+                import warnings
 
-            warnings.warn(f"EventLog disabled — write to {self._path!r} failed: {e}")
-            return None
+                warnings.warn(f"EventLog disabled — write to {self._path!r} failed: {e}")
+                return None
         return record
 
     def close(self) -> None:

@@ -6,7 +6,14 @@ time spent making training progress. This meter partitions wall time into:
 * ``productive_step``   — executing (or draining) compiled train steps;
 * ``compile``           — XLA tracing/compilation (first window per shape);
 * ``data_wait``         — the step loop blocked on the input pipeline;
-* ``checkpoint``        — save/commit time the step loop actually waited on;
+* ``checkpoint``        — save/commit time the step loop actually waited on
+  (under async checkpointing: just the device->host snapshot stall, plus
+  any emergency-save commit);
+* ``checkpoint_async``  — background checkpoint-commit time (the
+  ``resilience.AsyncCheckpointSaver`` worker's wall time per commit, booked
+  via :meth:`GoodputMeter.account` from its completion callback). This is
+  the save cost the hot loop *no longer* pays — overlapped with training,
+  so it is extra accounted time on top of the main thread's partition;
 * ``restart_rollback``  — resume overhead: checkpoint restore + replaying
   the loader past already-trained batches after a preemption;
 * ``other``             — everything else (validation, logging, epoch glue).
@@ -41,6 +48,7 @@ BUCKETS = (
     "compile",
     "data_wait",
     "checkpoint",
+    "checkpoint_async",
     "restart_rollback",
     "other",
 )
@@ -79,7 +87,12 @@ class GoodputMeter:
         return dt
 
     def account(self, bucket: str, seconds: float) -> None:
-        """Add an externally measured duration without touching the clock."""
+        """Add an externally measured duration without touching the clock.
+
+        Safe to call from a non-main thread for a bucket the main thread's
+        ``tick`` stream never writes (the async-commit worker books
+        ``checkpoint_async`` this way): distinct dict keys, so the += races
+        nothing."""
         if bucket not in self.buckets:
             raise KeyError(f"unknown goodput bucket {bucket!r} (one of {BUCKETS})")
         self.buckets[bucket] += float(seconds)

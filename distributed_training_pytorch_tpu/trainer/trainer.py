@@ -71,6 +71,7 @@ from distributed_training_pytorch_tpu.precision import (
     is_dynamic,
     resolve_loss_scale,
 )
+from distributed_training_pytorch_tpu.resilience import AsyncCheckpointSaver
 from distributed_training_pytorch_tpu.telemetry import (
     EventLog,
     GoodputMeter,
@@ -267,14 +268,25 @@ class Trainer:
         self._interrupted_at_step = 0
 
         # Save folder layout: <save_folder>/weights/<name> (``:29-32``).
+        # Asynchrony lives in the resilience layer now (ISSUE 5), not in the
+        # manager: the manager commits synchronously (each save it runs is
+        # fully durable when the call returns), and `async_checkpoint=True`
+        # routes periodic/best saves through AsyncCheckpointSaver — a fast
+        # device->host snapshot on this thread, the staging+manifest+rename
+        # commit on a background thread. Preemption/watchdog saves always
+        # commit synchronously (emergency path) regardless of this knob.
         self.save_folder = save_folder
         self.save_weight_folder = os.path.join(save_folder, "weights")
+        self._async_saves = bool(async_checkpoint)
         self.checkpoints = CheckpointManager(
             self.save_weight_folder,
             save_best_for=save_best_for,
-            async_save=async_checkpoint,
+            async_save=False,
             max_to_keep=max_checkpoints_to_keep,
             fault_plan=fault_plan,
+        )
+        self.saver = AsyncCheckpointSaver(
+            self.checkpoints, on_commit=self._on_async_commit
         )
 
         # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE
@@ -308,6 +320,9 @@ class Trainer:
             self.anomaly_detector = None
             self._flops_per_step = None
             self._peak_flops = 0.0
+        # Recovery skips (restore_latest_valid walking past a corrupt
+        # checkpoint) land in the event log as `checkpoint_rejected` records.
+        self.checkpoints.event_log = self.events
         # MFU probe bookkeeping: the first executed batch's abstract shapes
         # (ShapeDtypeStructs only — no device ops) feed the one-time
         # engine.step_cost_analysis probe at the end of the first epoch.
@@ -478,6 +493,14 @@ class Trainer:
             # protected again. The metrics writer closes here too so the
             # preemption early-return and error paths flush it.
             self._restore_sigterm()
+            # Error/preemption paths must not leave a background commit in
+            # flight into interpreter teardown; a commit error here must not
+            # mask the original exception (logged, not raised). close() also
+            # stops the commit worker — a process constructing many Trainers
+            # must not accumulate parked daemon threads (a re-entered
+            # train()'s next save restarts the worker transparently).
+            self._flush_saver_logged()
+            self.saver.close()
             if self.goodput is not None:
                 self.goodput.stop()
             if self.events.enabled:
@@ -591,7 +614,9 @@ class Trainer:
             self._write_precision_scalars()
             self._write_telemetry_scalars()
 
-        self.checkpoints.wait()
+        # Barrier: every queued background commit fully on disk (and any
+        # commit error surfaced) before the run declares itself finished.
+        self.saver.flush()
         self.log("Finished!")
 
     @property
@@ -632,6 +657,27 @@ class Trainer:
             return None
         return {"goodput": self.goodput.to_state()}
 
+    def _flush_saver_logged(self) -> None:
+        """Flush the async saver, reporting — never raising — a background
+        commit failure. For the paths where an exception would defeat the
+        path's own purpose: teardown (masking the original error), the
+        emergency-save exit (aborting the grace-window shutdown), and the
+        nan rollback (dying instead of degrading)."""
+        err = self.saver.flush(raise_errors=False)
+        if err is not None:
+            self.log(f"background checkpoint commit failed: {err}", "error")
+
+    def _on_async_commit(self, name: str, seconds: float) -> None:
+        """Background-commit completion callback (runs on the saver's worker
+        thread): book the commit's wall time to the ``checkpoint_async``
+        goodput bucket — time the hot loop did NOT stall for — and leave a
+        ``checkpoint_commit`` record in the flight log. Both sinks are
+        thread-safe (``GoodputMeter.account`` touches a bucket the tick
+        stream never writes; ``EventLog.emit`` locks)."""
+        if self.goodput is not None:
+            self.goodput.account("checkpoint_async", seconds)
+        self.events.emit("checkpoint_commit", name=name, commit_ms=seconds * 1e3)
+
     def _save_checkpoint(
         self,
         name: str,
@@ -644,30 +690,60 @@ class Trainer:
         best: bool = False,
     ) -> bool:
         """Checkpoint save + telemetry, one implementation for every trainer
-        save site (last / periodic / preemption / best): goodput counters
-        into the meta, save (+ optional commit wait) attributed to the
-        ``checkpoint`` bucket, and a ``checkpoint_save`` event.
+        save site (last / periodic / preemption / best).
 
-        ``best=True`` routes through the manager's best-fitness rule
-        (``maybe_save_best``); returns whether a checkpoint was written."""
+        Two modes (docs/fault_tolerance.md state machine):
+
+        * **async** (``async_checkpoint=True`` and ``wait=False`` — the
+          periodic/best saves): device->host snapshot on this thread, commit
+          on the saver's background thread. Only the snapshot stall lands in
+          the ``checkpoint`` goodput bucket; the background commit books
+          itself to ``checkpoint_async`` via ``_on_async_commit``.
+        * **emergency** (``wait=True`` — preemption and watchdog saves, or
+          ``async_checkpoint=False``): flush any in-flight background save
+          (completing it, never abandoning it), then commit synchronously —
+          the save must be durable inside the eviction grace window. The
+          full wall time is hot-loop stall, booked to ``checkpoint``.
+
+        ``best=True`` routes through the manager's best-fitness rule;
+        returns whether a checkpoint was written."""
         if self.goodput is not None:
             self.goodput.tick("other")  # close the epoch-glue interval
+        mode = "async" if (self._async_saves and not wait) else "sync"
+        telemetry_meta = self._telemetry_meta()
+        snapshot_s = None
         if best:
-            saved = self.checkpoints.maybe_save_best(
-                metrics, self.state, epoch, telemetry=self._telemetry_meta()
-            )
+            if mode == "async":
+                saved, snapshot_s = self.saver.maybe_save_best(
+                    metrics, self.state, epoch, telemetry=telemetry_meta
+                )
+            else:
+                saved = self.checkpoints.maybe_save_best(
+                    metrics, self.state, epoch, telemetry=telemetry_meta
+                )
         else:
-            self.checkpoints.save(
-                name, self.state, epoch, metrics=metrics, loop_state=loop_state,
-                telemetry=self._telemetry_meta(),
-            )
+            if mode == "async":
+                snapshot_s = self.saver.save_async(
+                    name, self.state, epoch, metrics=metrics,
+                    loop_state=loop_state, telemetry=telemetry_meta,
+                )
+            else:
+                self.saver.save_sync(
+                    name, self.state, epoch, metrics=metrics,
+                    loop_state=loop_state, telemetry=telemetry_meta,
+                )
             saved = True
         if wait:
-            self.checkpoints.wait()
+            # The emergency save above is already durable; a PRIOR background
+            # commit's failure (re-stashed by save_sync) must be reported,
+            # not abort the grace-window exit this save exists to protect.
+            self._flush_saver_logged()
         if self.goodput is not None:
             self.goodput.tick("checkpoint" if saved else "other")
         if saved:
-            fields = {"name": name, "epoch": epoch, "reason": reason}
+            fields = {"name": name, "epoch": epoch, "reason": reason, "mode": mode}
+            if snapshot_s is not None:
+                fields["snapshot_ms"] = snapshot_s * 1e3
             if loop_state:
                 fields["step_in_epoch"] = int(loop_state.get("step_in_epoch", 0))
             self.events.emit("checkpoint_save", **fields)
@@ -1210,6 +1286,10 @@ class Trainer:
         if self.nan_policy == "restore_last_good":
             from distributed_training_pytorch_tpu.checkpoint import CheckpointError
 
+            # Serialize with the background committer: the rollback must see
+            # a fully committed newest checkpoint (and the manager is
+            # single-threaded by contract — see AsyncCheckpointSaver).
+            self._flush_saver_logged()
             try:
                 self.state, epoch, name = self.checkpoints.restore_latest_valid(
                     self.state

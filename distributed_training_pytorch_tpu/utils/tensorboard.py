@@ -28,12 +28,21 @@ class MetricsWriter:
     def __init__(self, log_dir: str | None):
         self._log_dir = log_dir
         self._writer = None
+        self._dead = False  # a failed backend write disables the writer for good
         self.reopen()
 
     def reopen(self) -> None:
         """(Re)create the backend writer — lets a closed writer come back for a
-        re-entered ``train()`` instead of silently dropping all later scalars."""
-        if self._writer is not None or not self._log_dir or jax.process_index() != 0:
+        re-entered ``train()`` instead of silently dropping all later scalars.
+        A writer disabled by a backend failure stays disabled (the filesystem
+        that failed once is not coming back mid-run; retrying every scalar
+        would spam the failure)."""
+        if (
+            self._writer is not None
+            or self._dead
+            or not self._log_dir
+            or jax.process_index() != 0
+        ):
             return
         try:
             from tensorboardX import SummaryWriter
@@ -54,20 +63,39 @@ class MetricsWriter:
         that are not scalar, or not finite (a NaN epoch loss under
         ``nan_policy``, an Inf ``update_ratio`` on a poisoned step), are
         skipped: a bad value must cost one missing curve point, never the
-        writer (and with it every later scalar of the run)."""
+        writer (and with it every later scalar of the run).
+
+        Backend failures follow the event-log policy (try once, then
+        disable): a full disk or a dead filesystem under the TensorBoard
+        directory warns and permanently disables this writer — metrics are
+        observability, never the reason training dies."""
         if self._writer is None:
             return
         step = int(step)
-        for key, value in metrics.items():
+        try:
+            for key, value in metrics.items():
+                try:
+                    value = float(np.asarray(value).reshape(()))
+                except (TypeError, ValueError):
+                    continue  # non-scalar entries are not TensorBoard material
+                if not math.isfinite(value):
+                    continue  # tolerate NaN/Inf: skip the point, keep the writer
+                tag = f"{prefix}/{key}" if prefix else key
+                self._writer.add_scalar(tag, value, step)
+            self._writer.flush()
+        except Exception as e:  # noqa: BLE001 — any backend failure, same policy
+            self._dead = True
+            writer, self._writer = self._writer, None
             try:
-                value = float(np.asarray(value).reshape(()))
-            except (TypeError, ValueError):
-                continue  # non-scalar entries are not TensorBoard material
-            if not math.isfinite(value):
-                continue  # tolerate NaN/Inf: skip the point, keep the writer
-            tag = f"{prefix}/{key}" if prefix else key
-            self._writer.add_scalar(tag, value, step)
-        self._writer.flush()
+                writer.close()
+            except Exception:  # noqa: BLE001 — already failing; best-effort close
+                pass
+            import warnings
+
+            warnings.warn(
+                f"MetricsWriter disabled — TensorBoard write to "
+                f"{self._log_dir!r} failed: {e}"
+            )
 
     def close(self) -> None:
         if self._writer is not None:

@@ -1,0 +1,486 @@
+#!/usr/bin/env python
+"""Kill/resume chaos soak — the resilience subsystem's proof (ISSUE 5).
+
+Runs a short REAL training job (sklearn digits, the offline stand-in every
+accuracy clause uses: async checkpointing on, chained windows on, telemetry
+on) and kills it with SIGTERM/SIGKILL at randomized — but **seeded** —
+step offsets, N times, resuming from ``snapshot_path="latest_valid"`` after
+every kill. The kill schedule deliberately includes:
+
+* a **graceful SIGTERM** (the cloud-scheduler preemption path: flag ->
+  collective vote -> emergency save -> clean exit);
+* a **SIGKILL mid-background-commit** (the async saver's worker is inside
+  the committing state — widened deterministically via the saver's
+  ``commit_delay_s`` chaos seam — when the process dies);
+* **SIGKILL at a random mid-epoch step** (with ``chain_steps=2`` this lands
+  mid-chained-window: the device program dies between window boundaries).
+
+Assertions (the acceptance criteria, checked by ``main``):
+
+1. every kill leaves **>= 1 valid restorable checkpoint** on disk (validated
+   against the SHA-256 manifest with a stdlib re-implementation of
+   ``CheckpointManager.validate`` — the parent never imports jax, so the
+   check cannot share a bug with the code under test);
+2. every resume **succeeds** and the soaked run reaches completion;
+3. the soaked run's final params are **bit-exact** with an uninterrupted
+   reference run's (numpy array equality, every leaf);
+4. the async save's hot-loop stall is **< 25 % of the synchronous save wall
+   time** (measured on the same digits state by the reference child).
+
+Usage::
+
+    python scripts/chaos_soak.py --quick      # ~3 kills, CI stage (verify.sh)
+    python scripts/chaos_soak.py              # full soak: 5 kills
+    CHAOS_SEED=7 python scripts/chaos_soak.py # reproduce a failing schedule
+
+``CHAOS_SEED`` (or ``--seed``) seeds the kill schedule, so a failure
+reproduces deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+MANIFEST_NAME = "manifest.dtp.json"
+STALL_MARKER = "CHAOS_STALL_JSON="
+CHILD_TIMEOUT_S = 300.0  # hard bound per child attempt (compile + epochs)
+TRIGGER_TIMEOUT_S = 120.0  # bound on waiting for a kill trigger
+# Child exit codes the parent understands.
+EXIT_OK = 0
+EXIT_PREEMPTED = 3  # clean SIGTERM shutdown with a resumable save
+
+
+# ---------------------------------------------------------------------------
+# Child: the real training job (imports jax; run as a subprocess).
+
+
+def child_main(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    import jax
+
+    from distributed_training_pytorch_tpu.data import ArrayDataSource
+    from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+    from distributed_training_pytorch_tpu.trainer import Trainer
+
+    class DigitsNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    class SoakTrainer(Trainer):
+        def build_train_dataset(self):
+            from sklearn.datasets import load_digits
+
+            digits = load_digits()
+            images = (digits.images / 16.0).astype(np.float32)[..., None]
+            labels = digits.target.astype(np.int32)
+            # Tile the corpus: ~42 steps/epoch at batch 128, so epochs last
+            # long enough for the parent to land kills mid-epoch instead of
+            # racing a sub-second training run.
+            images = np.concatenate([images] * 3)
+            labels = np.concatenate([labels] * 3)
+            return ArrayDataSource(image=images, label=labels)
+
+        def build_model(self):
+            return DigitsNet()
+
+        def build_criterion(self):
+            def criterion(logits, batch):
+                loss = cross_entropy_loss(logits, batch["label"])
+                return loss, {"loss": loss}
+
+            return criterion
+
+        def build_optimizer(self, schedule):
+            return optax.sgd(schedule, momentum=0.9)
+
+        def build_scheduler(self):
+            return 0.1
+
+    trainer = SoakTrainer(
+        max_epoch=args.max_epoch,
+        batch_size=128,
+        save_folder=args.run_dir,
+        snapshot_path="latest_valid",  # idempotent: cold start on first launch
+        have_validate=False,
+        save_period=1,  # periodic checkpoint every epoch (async commit)
+        async_checkpoint=True,
+        chain_steps=2,  # kills land mid-chained-window
+        log_every=4,  # window events = the parent's step-progress signal
+        preemption_check_every=2,
+        telemetry="on",
+        num_workers=0,
+        progress=False,
+        seed=0,
+    )
+    if args.commit_delay > 0:
+        # Chaos seam: hold each background commit in the `committing` state
+        # for this long so the parent can SIGKILL inside the window.
+        trainer.saver.commit_delay_s = args.commit_delay
+    trainer.train()
+    if trainer._preempted:
+        return EXIT_PREEMPTED
+
+    # Completed: dump final params for the bit-exactness check.
+    leaves = jax.device_get(jax.tree.leaves(trainer.state.params))
+    np.savez(args.final, **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
+    digest = hashlib.sha256()
+    for leaf in leaves:
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"CHAOS_PARAMS_SHA={digest.hexdigest()}", flush=True)
+
+    if args.measure_stall:
+        _measure_stall(trainer)
+    return EXIT_OK
+
+
+def _measure_stall(trainer) -> None:
+    """Sync-save wall vs async-save hot-loop stall, on the trained state —
+    the ISSUE 5 acceptance measurement, printed as one parseable line.
+    Best-of-3 via the SAME helper bench.py's save_stall fields use
+    (``resilience.measure_save_stall``), so the acceptance ratio and the
+    benchmark metric cannot drift apart."""
+    from distributed_training_pytorch_tpu.checkpoint import CheckpointManager
+    from distributed_training_pytorch_tpu.resilience import measure_save_stall
+
+    measure_dir = os.path.join(trainer.save_folder, "stall_measure")
+    with CheckpointManager(measure_dir, async_save=False) as mgr:
+        stall = measure_save_stall(mgr, trainer.state, repeats=3)
+    best = {
+        "sync_ms": stall["sync_ms"],
+        "async_ms": stall["stall_ms"],
+        "commit_ms": stall["commit_ms"],
+    }
+    print(STALL_MARKER + json.dumps(best), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent: orchestration, kill schedule, validation (stdlib only — no jax).
+
+
+def valid_checkpoints(weights_dir: str) -> list[str]:
+    """Committed checkpoint names passing manifest validation. A stdlib
+    re-implementation of ``CheckpointManager.validate`` (size + SHA-256 per
+    file), so the soak's 'is there something restorable?' check is
+    independent of the code under test."""
+    names = []
+    if not os.path.isdir(weights_dir):
+        return names
+    for entry in sorted(os.listdir(weights_dir)):
+        if entry.startswith(".") or entry.endswith(".old"):
+            continue
+        path = os.path.join(weights_dir, entry)
+        manifest_path = os.path.join(path, MANIFEST_NAME)
+        if not os.path.isdir(path) or not os.path.isfile(manifest_path):
+            continue
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+            ok = True
+            for rel, want in manifest.get("files", {}).items():
+                fp = os.path.join(path, rel)
+                if not os.path.isfile(fp) or os.path.getsize(fp) != want["size"]:
+                    ok = False
+                    break
+                digest = hashlib.sha256()
+                with open(fp, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        digest.update(chunk)
+                if digest.hexdigest() != want["sha256"]:
+                    ok = False
+                    break
+        except (OSError, json.JSONDecodeError, KeyError, TypeError):
+            ok = False
+        if ok:
+            names.append(entry)
+    return names
+
+
+class EventTail:
+    """Incremental reader of the child's JSONL event log (lenient: a torn
+    last line from a hard kill parses later or never — expected)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+
+    def poll(self) -> list[dict]:
+        records = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                data = f.read()
+        except OSError:
+            return records
+        # Only consume complete lines; a partial tail stays for next poll.
+        end = data.rfind(b"\n")
+        if end < 0:
+            return records
+        self.offset += end + 1
+        for line in data[: end + 1].splitlines():
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return records
+
+
+def spawn_child(script, run_dir, final, max_epoch, commit_delay, measure_stall, log):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # NO persistent XLA compilation cache here, deliberately: a SIGKILL'd
+    # writer can leave a cache entry that segfaults the next process at
+    # deserialization (observed on this jax version) — the one crash the
+    # checkpoint machinery cannot save us from. Each attempt pays its own
+    # compile; the soak measures recovery, not wall time.
+    cmd = [
+        sys.executable, script, "--child",
+        "--run-dir", run_dir,
+        "--final", final,
+        "--max-epoch", str(max_epoch),
+        "--commit-delay", str(commit_delay),
+    ]
+    if measure_stall:
+        cmd.append("--measure-stall")
+    return subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def wait_child(proc, timeout=CHILD_TIMEOUT_S) -> int:
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        raise SystemExit("chaos_soak: child exceeded its wall-time bound (hung?)")
+
+
+def run_soak(args) -> int:
+    script = os.path.abspath(__file__)
+    seed = int(os.environ.get("CHAOS_SEED", args.seed))
+    import random
+
+    rng = random.Random(seed)
+    workdir = tempfile.mkdtemp(prefix="chaos_soak_")
+    max_epoch = 3 if args.quick else 4
+    n_kills = 3 if args.quick else args.kills
+    # Schedule: rotate through the three kill shapes, guaranteeing >= 1
+    # graceful SIGTERM and >= 1 SIGKILL mid-background-commit.
+    shapes = [("SIGTERM", "step"), ("SIGKILL", "commit"), ("SIGKILL", "step")]
+    schedule = [shapes[i % len(shapes)] for i in range(n_kills)]
+    commit_delay = 1.0
+    print(
+        f"chaos_soak: seed={seed} kills={n_kills} max_epoch={max_epoch} "
+        f"workdir={workdir}\n  schedule: {schedule}"
+    )
+
+    failures: list[str] = []
+    kill_log: list[str] = []
+    try:
+        # -- reference: uninterrupted run (also measures save stall) -------
+        ref_dir = os.path.join(workdir, "ref")
+        ref_final = os.path.join(workdir, "ref_final.npz")
+        ref_log_path = os.path.join(workdir, "ref.log")
+        with open(ref_log_path, "w") as log:
+            rc = wait_child(
+                spawn_child(script, ref_dir, ref_final, max_epoch, 0.0, True, log)
+            )
+        if rc != EXIT_OK or not os.path.isfile(ref_final):
+            print(open(ref_log_path).read()[-4000:], file=sys.stderr)
+            raise SystemExit(f"chaos_soak: reference run failed (exit {rc})")
+        stall = None
+        for line in open(ref_log_path):
+            if line.startswith(STALL_MARKER):
+                stall = json.loads(line[len(STALL_MARKER):])
+
+        # -- soaked run: kill / verify / resume ----------------------------
+        soak_dir = os.path.join(workdir, "soak")
+        soak_final = os.path.join(workdir, "soak_final.npz")
+        weights = os.path.join(soak_dir, "weights")
+        events = EventTail(os.path.join(soak_dir, "telemetry", "events.jsonl"))
+        soak_log_path = os.path.join(workdir, "soak.log")
+        log = open(soak_log_path, "w")
+
+        for i, (sig_name, trigger) in enumerate(schedule):
+            # Drain events left over from the previous attempt's final
+            # moments: stale window/save records must not satisfy THIS
+            # attempt's trigger and kill the child during startup.
+            events.poll()
+            proc = spawn_child(
+                script, soak_dir, soak_final, max_epoch, commit_delay, False, log
+            )
+            died = _wait_and_kill(proc, events, weights, sig_name, trigger, rng)
+            rc = wait_child(proc, timeout=60.0)
+            survivors = valid_checkpoints(weights)
+            kill_log.append(
+                f"kill {i + 1}/{n_kills}: {sig_name}@{trigger} ({died}) -> "
+                f"exit {rc}, {len(survivors)} valid checkpoint(s): {survivors}"
+            )
+            print("  " + kill_log[-1])
+            if died == "child exited before kill":
+                # The schedule demands N REAL kills; a child that finished
+                # before its kill landed means the harness lost the race.
+                failures.append(
+                    f"kill {i + 1} ({sig_name}@{trigger}) never landed — "
+                    "child completed first"
+                )
+                continue
+            if sig_name == "SIGTERM" and rc != EXIT_PREEMPTED:
+                failures.append(
+                    f"kill {i + 1}: SIGTERM child exited {rc}, expected clean "
+                    f"preemption exit {EXIT_PREEMPTED}"
+                )
+            if not survivors:
+                failures.append(
+                    f"kill {i + 1} ({sig_name}@{trigger}) left ZERO valid checkpoints"
+                )
+
+        # -- final resume to completion ------------------------------------
+        proc = spawn_child(script, soak_dir, soak_final, max_epoch, 0.0, False, log)
+        rc = wait_child(proc)
+        log.close()
+        if rc != EXIT_OK or not os.path.isfile(soak_final):
+            print(open(soak_log_path).read()[-4000:], file=sys.stderr)
+            failures.append(f"final resume did not complete (exit {rc})")
+
+        # -- bit-exactness -------------------------------------------------
+        if os.path.isfile(soak_final):
+            import numpy as np
+
+            ref = np.load(ref_final)
+            soak = np.load(soak_final)
+            if sorted(ref.files) != sorted(soak.files):
+                failures.append("final param trees differ in structure")
+            else:
+                for key in ref.files:
+                    if not np.array_equal(ref[key], soak[key]):
+                        failures.append(
+                            f"final params NOT bit-exact (leaf {key} differs)"
+                        )
+                        break
+                else:
+                    print(
+                        f"  final params bit-exact across {n_kills} kills "
+                        f"({len(ref.files)} leaves)"
+                    )
+
+        # -- async stall acceptance ----------------------------------------
+        if stall is None:
+            failures.append("reference run produced no save-stall measurement")
+        else:
+            ratio = stall["async_ms"] / max(stall["sync_ms"], 1e-9)
+            print(
+                f"  save stall: sync {stall['sync_ms']:.1f} ms, async snapshot "
+                f"{stall['async_ms']:.2f} ms (ratio {ratio:.3f}), background "
+                f"commit {stall['commit_ms']:.1f} ms"
+            )
+            if stall["sync_ms"] < 5.0:
+                print("  (sync save < 5 ms — ratio check skipped as noise)")
+            elif ratio >= 0.25:
+                failures.append(
+                    f"async hot-loop stall is {ratio:.0%} of the sync save "
+                    "wall time (acceptance: < 25%)"
+                )
+    finally:
+        if args.keep:
+            print(f"chaos_soak: artifacts kept at {workdir}")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    if failures:
+        print("CHAOS SOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        print(f"  reproduce with CHAOS_SEED={seed}", file=sys.stderr)
+        return 1
+    print(
+        f"chaos soak OK: {n_kills} kills (seed {seed}), every kill left a valid "
+        "checkpoint, every resume succeeded, final params bit-exact"
+    )
+    return 0
+
+
+def _wait_and_kill(proc, events, weights_dir, sig_name, trigger, rng) -> str:
+    """Block until the seeded trigger condition holds, then deliver the
+    signal. Returns a short description of the actual kill point."""
+    sig = signal.SIGTERM if sig_name == "SIGTERM" else signal.SIGKILL
+    deadline = time.monotonic() + TRIGGER_TIMEOUT_S
+    # Randomized (seeded) step offset: fire after the k-th window event of
+    # THIS attempt (window events land every log_every=4 steps), plus a
+    # sub-step jitter sleep so the kill lands anywhere inside a window.
+    target_windows = rng.randint(1, 3)
+    jitter = rng.uniform(0.0, 0.25)
+    windows_seen = 0
+    commit_armed = False
+    desc = "trigger timeout"
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return "child exited before kill"
+        for rec in events.poll():
+            kind = rec.get("event")
+            if kind == "window":
+                windows_seen += 1
+            elif kind == "checkpoint_save" and rec.get("mode") == "async":
+                commit_armed = True
+        if trigger == "commit" and commit_armed:
+            # The async commit worker is inside its commit_delay_s window
+            # right now: sleep partway into it, then SIGKILL mid-commit.
+            time.sleep(0.5)
+            desc = "mid-background-commit"
+            break
+        if trigger == "step" and windows_seen >= target_windows:
+            # SIGKILL must find something restorable on disk already; the
+            # SIGTERM path saves its own emergency checkpoint on the way out.
+            if sig == signal.SIGKILL and not valid_checkpoints(weights_dir):
+                time.sleep(0.02)
+                continue
+            time.sleep(jitter)
+            desc = f"after window {windows_seen} (+{jitter:.2f}s)"
+            break
+        time.sleep(0.02)
+    if proc.poll() is None:
+        try:
+            os.kill(proc.pid, sig)
+        except ProcessLookupError:
+            return "child exited before kill"
+    return desc
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quick", action="store_true", help="CI mode: 3 kills, 3 epochs")
+    parser.add_argument("--kills", type=int, default=5, help="kill count (full mode)")
+    parser.add_argument("--seed", type=int, default=0, help="kill-schedule seed (CHAOS_SEED wins)")
+    parser.add_argument("--keep", action="store_true", help="keep the work dir")
+    # child-mode flags
+    parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--run-dir", dest="run_dir", help=argparse.SUPPRESS)
+    parser.add_argument("--final", help=argparse.SUPPRESS)
+    parser.add_argument("--max-epoch", dest="max_epoch", type=int, default=3, help=argparse.SUPPRESS)
+    parser.add_argument("--commit-delay", dest="commit_delay", type=float, default=0.0, help=argparse.SUPPRESS)
+    parser.add_argument("--measure-stall", dest="measure_stall", action="store_true", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+    if args.child:
+        return child_main(args)
+    return run_soak(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

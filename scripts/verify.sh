@@ -21,12 +21,19 @@
 # log is well-formed JSONL, goodput bucket fractions sum to 1 +- eps, and the
 # on-device health stats rode the chained windows without a retrace.
 #
-# Stage 5 is the ROADMAP.md tier-1 command verbatim.
+# Stage 5 is the chaos soak in --quick mode: a real digits training job killed
+# 3 times (graceful SIGTERM, SIGKILL mid-background-commit, SIGKILL mid-
+# chained-window) at seeded offsets, resumed after each kill, asserting every
+# kill leaves >= 1 valid checkpoint, the final params are bit-exact with an
+# uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
+# save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
+#
+# Stage 6 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/5: import health (pytest --collect-only) =="
+echo "== stage 1/6: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -35,25 +42,31 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/5: chained-dispatch retrace guard =="
+echo "== stage 2/6: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 3
 fi
 
-echo "== stage 3/5: mixed-precision smoke (bf16 digits) =="
+echo "== stage 3/6: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 4
 fi
 
-echo "== stage 4/5: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 4/6: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 5
 fi
 
-echo "== stage 5/5: tier-1 test suite =="
+echo "== stage 5/6: chaos soak (kill/resume, async checkpointing) =="
+if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
+  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+  exit 6
+fi
+
+echo "== stage 6/6: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -1,0 +1,391 @@
+"""Resilience-layer tests (ISSUE 5): async checkpointing around the
+crash-consistent manager, plus the satellite guards.
+
+Acceptance pillars:
+
+* a save's hot-loop cost is the device->host snapshot only; the commit runs
+  on the background worker through the UNchanged staging+manifest+rename
+  machinery, in enqueue order, with newest-wins coalescing per name;
+* emergency saves (SIGTERM / watchdog) flush — complete, never abandon —
+  in-flight background commits before committing synchronously, with no
+  interleaved staging directories;
+* background commit failures surface on the training thread (flush / next
+  save), exactly as loud as a failed synchronous save;
+* `restore_latest_valid` rejections land in the JSONL event log;
+* a TensorBoard backend failure disables the MetricsWriter with one
+  warning — never kills training.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.checkpoint import (
+    BEST,
+    LAST,
+    CheckpointError,
+    CheckpointManager,
+)
+from distributed_training_pytorch_tpu.data import ArrayDataSource
+from distributed_training_pytorch_tpu.fault import FaultPlan, corrupt_checkpoint
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.resilience import AsyncCheckpointSaver
+from distributed_training_pytorch_tpu.telemetry import EventLog, read_events
+from distributed_training_pytorch_tpu.trainer import Trainer
+from distributed_training_pytorch_tpu.utils.tensorboard import MetricsWriter
+
+from test_fault import _tiny_state
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return mesh_lib.create_mesh({mesh_lib.DATA_AXIS: 8}, devices=devices)
+
+
+def _assert_params_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointSaver around a bare manager (no trainer — fast).
+
+
+def test_async_save_commits_in_background_and_restores(tmp_path):
+    commits = []
+    with CheckpointManager(tmp_path / "c", async_save=False) as mgr:
+        saver = AsyncCheckpointSaver(mgr, on_commit=lambda n, s: commits.append((n, s)))
+        state = _tiny_state(seed=1, step=5)
+        stall = saver.save_async(LAST, state, epoch=3, loop_state={"step_in_epoch": 2})
+        assert stall >= 0.0
+        saver.flush()
+        assert saver.committed == 1 and saver.in_flight is False
+        assert commits and commits[0][0] == LAST and commits[0][1] > 0
+        assert mgr.is_valid(LAST)
+        restored, epoch = mgr.restore(LAST, _tiny_state(seed=9))
+        assert epoch == 3
+        assert mgr.read_meta(LAST)["loop"] == {"step_in_epoch": 2}
+        _assert_params_equal(restored.params, state.params)
+        saver.close()
+
+
+def test_newest_wins_supersedes_queued_same_name(tmp_path):
+    """Depth-1 per name: while a commit is held in flight, a newer `last`
+    snapshot replaces the queued older one — the superseded snapshot was
+    never visible on disk, and the final committed `last` is the newest."""
+    with CheckpointManager(tmp_path / "c", async_save=False) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saver.commit_delay_s = 0.5  # hold the worker in `committing`
+        states = [_tiny_state(seed=s, step=s) for s in (1, 2, 3)]
+        for i, state in enumerate(states):
+            saver.save_async(LAST, state, epoch=i)
+        saver.commit_delay_s = 0.0
+        saver.flush()
+        assert saver.superseded >= 1
+        assert saver.committed + saver.superseded == 3
+        restored, epoch = mgr.restore(LAST, _tiny_state(seed=9))
+        assert epoch == 2
+        _assert_params_equal(restored.params, states[-1].params)
+        saver.close()
+
+
+def test_distinct_names_queue_fifo_never_dropped(tmp_path):
+    """`best` then `last` at an epoch boundary: different names must BOTH
+    commit (newest-wins applies per name), in enqueue order."""
+    with CheckpointManager(tmp_path / "c", async_save=False) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saver.commit_delay_s = 0.3
+        saver.save_async(BEST, _tiny_state(seed=1), epoch=1)
+        saver.save_async(LAST, _tiny_state(seed=2), epoch=2)
+        saver.commit_delay_s = 0.0
+        saver.flush()
+        assert saver.committed == 2 and saver.superseded == 0
+        assert mgr.is_valid(BEST) and mgr.is_valid(LAST)
+        # commit (= mtime) order matches enqueue order
+        assert os.path.getmtime(mgr.path(BEST)) <= os.path.getmtime(mgr.path(LAST))
+        saver.close()
+
+
+def test_background_commit_error_surfaces_on_flush(tmp_path):
+    """A background save that exhausts its retries must fail the TRAINING
+    thread at the next barrier, not vanish on the worker."""
+    plan = FaultPlan().add("checkpoint_write", count=10)
+    with CheckpointManager(
+        tmp_path / "c", async_save=False, save_retries=1, retry_backoff=0.01,
+        fault_plan=plan,
+    ) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saver.save_async(LAST, _tiny_state(), epoch=1)
+        with pytest.raises(CheckpointError):
+            saver.flush()
+        assert saver.flush() is None  # error consumed exactly once
+        saver.close()
+
+
+def test_save_sync_defers_but_never_drops_prior_background_error(tmp_path):
+    """An emergency save must run even when the preceding background commit
+    failed — but that failure is re-stashed, not swallowed: the next flush
+    still raises it."""
+    plan = FaultPlan().add("checkpoint_write", count=2)  # async save's 2 attempts
+    with CheckpointManager(
+        tmp_path / "c", async_save=False, save_retries=1, retry_backoff=0.01,
+        fault_plan=plan,
+    ) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saver.save_async("checkpoint_epoch_1", _tiny_state(seed=1), epoch=1)
+        saver.save_sync(LAST, _tiny_state(seed=2), epoch=1)  # must not raise
+        assert mgr.is_valid(LAST)
+        with pytest.raises(CheckpointError):
+            saver.flush()
+        saver.close()
+
+
+def test_emergency_save_flushes_in_flight_commit_first(tmp_path):
+    """save_sync completes the queued background save before its own commit:
+    both checkpoints land, in order, via the single committer."""
+    with CheckpointManager(tmp_path / "c", async_save=False) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saver.commit_delay_s = 0.4
+        saver.save_async("checkpoint_epoch_1", _tiny_state(seed=1), epoch=1)
+        saver.commit_delay_s = 0.0
+        saver.save_sync(LAST, _tiny_state(seed=2), epoch=1, loop_state={"step_in_epoch": 3})
+        # the emergency save is durable the moment save_sync returns
+        assert mgr.is_valid(LAST) and mgr.is_valid("checkpoint_epoch_1")
+        assert saver.committed == 1  # the async one; `last` went inline
+        assert os.path.getmtime(mgr.path("checkpoint_epoch_1")) <= os.path.getmtime(
+            mgr.path(LAST)
+        )
+        saver.close()
+
+
+def test_maybe_save_best_async_applies_rule_on_thread(tmp_path):
+    with CheckpointManager(
+        tmp_path / "c", async_save=False, save_best_for=("accuracy", "geq")
+    ) as mgr:
+        saver = AsyncCheckpointSaver(mgr)
+        saved, _ = saver.maybe_save_best({"accuracy": 0.5}, _tiny_state(seed=1), 1)
+        assert saved
+        saved, _ = saver.maybe_save_best({"accuracy": 0.4}, _tiny_state(seed=2), 2)
+        assert not saved  # no improvement: nothing queued
+        saver.flush()
+        assert saver.committed == 1 and mgr.best_value == 0.5
+        restored, epoch = mgr.restore(BEST, _tiny_state(seed=9))
+        assert epoch == 1
+        saver.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: restore_latest_valid rejections are telemetry events.
+
+
+def test_restore_latest_valid_emits_rejected_events(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with CheckpointManager(tmp_path / "c", async_save=False) as mgr:
+        mgr.event_log = EventLog(log_path)
+        mgr.save("checkpoint_epoch_1", _tiny_state(seed=1, step=10), epoch=1)
+        time.sleep(0.05)  # distinct mtimes for newest-first ordering
+        mgr.save(LAST, _tiny_state(seed=2, step=20), epoch=2)
+        corrupt_checkpoint(mgr.path(LAST), mode="truncate")
+        _, epoch, name = mgr.restore_latest_valid(_tiny_state(seed=9))
+        assert name == "checkpoint_epoch_1" and epoch == 1
+        rejected = [
+            e for e in read_events(log_path) if e["event"] == "checkpoint_rejected"
+        ]
+        assert [e["name"] for e in rejected] == [LAST]
+        assert "torn write" in rejected[0]["reason"]
+        mgr.event_log.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: MetricsWriter try-once-then-disable.
+
+
+class _ExplodingBackend:
+    def __init__(self, exc):
+        self.exc = exc
+        self.closed = False
+
+    def add_scalar(self, *args):
+        raise self.exc
+
+    def flush(self):
+        raise self.exc
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.mark.parametrize("exc", [OSError("disk full"), RuntimeError("backend died")])
+def test_metrics_writer_disables_on_backend_failure(exc):
+    writer = MetricsWriter(None)
+    writer._log_dir = "/nonexistent/tb"  # simulate an active backend
+    backend = _ExplodingBackend(exc)
+    writer._writer = backend
+    with pytest.warns(UserWarning, match="MetricsWriter disabled"):
+        writer.write(1, {"loss": 1.0})
+    assert not writer.active and backend.closed
+    writer.write(2, {"loss": 2.0})  # silent no-op: no raise, no new warning
+    writer.reopen()  # a disabled writer must STAY disabled
+    assert not writer.active
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: a tiny real run with async checkpointing.
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(3)(x)
+
+
+class TinyTrainer(Trainer):
+    def build_train_dataset(self):
+        rng = np.random.RandomState(0)
+        labels = rng.randint(0, 3, size=(48,)).astype(np.int32)
+        images = (rng.randn(48, 4, 4, 3) + labels[:, None, None, None]).astype(
+            np.float32
+        )
+        return ArrayDataSource(image=images, label=labels)
+
+    def build_model(self):
+        return TinyNet()
+
+    def build_criterion(self):
+        def crit(logits, batch):
+            loss = cross_entropy_loss(logits, batch["label"])
+            return loss, {"loss": loss}
+
+        return crit
+
+    def build_optimizer(self, schedule):
+        import optax
+
+        return optax.sgd(schedule)
+
+    def build_scheduler(self):
+        return 0.05
+
+
+class _Quiet:
+    def log(self, *a, **k):
+        pass
+
+
+def make_tiny(tmp_path, mesh, **kw):
+    defaults = dict(
+        max_epoch=2,
+        batch_size=8,
+        have_validate=False,
+        save_period=1,  # periodic save every epoch (the async stream)
+        save_folder=str(tmp_path / "runs"),
+        num_workers=0,
+        log_every=0,
+        async_checkpoint=True,
+        mesh=mesh,
+        progress=False,
+        logger=_Quiet(),
+    )
+    defaults.update(kw)
+    return TinyTrainer(**defaults)
+
+
+def test_trainer_async_saves_commit_and_params_match_sync(tmp_path, mesh):
+    """Async checkpointing observes the run, it does not alter it: final
+    params bit-exact with a sync-save run, every periodic save fully
+    committed by the end-of-training flush, and the flight record carries
+    the async narrative (save mode, commit events, checkpoint_async time)."""
+    a = make_tiny(tmp_path / "async", mesh, telemetry="on")
+    a.train()
+    b = make_tiny(tmp_path / "sync", mesh, async_checkpoint=False)
+    b.train()
+    _assert_params_equal(a.state.params, b.state.params)
+
+    # every epoch's periodic checkpoint committed and validates
+    for name in ("checkpoint_epoch_1", "checkpoint_epoch_2"):
+        assert a.checkpoints.is_valid(name), name
+    assert a.saver.committed == 2
+
+    events = list(
+        read_events(os.path.join(a.save_folder, "telemetry", "events.jsonl"))
+    )
+    saves = [e for e in events if e["event"] == "checkpoint_save"]
+    assert saves and all(e["mode"] == "async" for e in saves)
+    assert all(e["snapshot_ms"] >= 0 for e in saves)
+    commits = [e for e in events if e["event"] == "checkpoint_commit"]
+    assert len(commits) == 2 and all(e["commit_ms"] > 0 for e in commits)
+    # the flight record stays strictly ordered despite the worker emitting
+    mono = [e["t_mono"] for e in events]
+    assert mono == sorted(mono)
+    # goodput: the background commit time is visible, split from the stall,
+    # and the stall (snapshot-only) is a fraction of the commit it replaced
+    assert a.goodput.buckets["checkpoint_async"] > 0
+    assert 0 < a.goodput.buckets["checkpoint"] < a.goodput.buckets["checkpoint_async"]
+    assert abs(sum(a.goodput.fractions().values()) - 1.0) < 1e-9
+
+
+def test_watchdog_fires_with_async_commit_in_flight(tmp_path, mesh):
+    """Satellite 3 (the watchdog x async interplay): epoch 0's periodic save
+    is still committing (held by the chaos seam) when a hung step in epoch 1
+    trips the StepWatchdog. The preemption-style emergency save must FLUSH
+    the in-flight commit — both checkpoints land, ordered, with no
+    interleaved staging directories — and record the hung step's position."""
+    plan = FaultPlan().add("hang", epoch=1, step=1, payload=0.8)
+    trainer = make_tiny(
+        tmp_path, mesh, step_timeout=0.2, fault_plan=plan, telemetry="on"
+    )
+    trainer.saver.commit_delay_s = 3.0  # hold epoch 0's commit in flight
+    trainer.train()
+
+    assert trainer._preempted
+    # the held background save was completed, not abandoned
+    assert trainer.checkpoints.is_valid("checkpoint_epoch_1")
+    assert trainer.saver.committed == 1
+    # the emergency save landed after it and is valid + resumable
+    assert trainer.checkpoints.is_valid(LAST)
+    meta = trainer.checkpoints.read_meta(LAST)
+    assert meta["loop"]["step_in_epoch"] == 1  # step 0 done, step 1 hung
+    assert os.path.getmtime(
+        trainer.checkpoints.path("checkpoint_epoch_1")
+    ) <= os.path.getmtime(trainer.checkpoints.path(LAST))
+    # single-committer invariant: no staging leftovers from interleaving
+    staging = os.path.join(trainer.save_weight_folder, ".staging")
+    leftovers = [e for e in os.listdir(staging)] if os.path.isdir(staging) else []
+    assert leftovers == []
+    # the flight record shows the whole story in order
+    events = list(
+        read_events(os.path.join(trainer.save_folder, "telemetry", "events.jsonl"))
+    )
+    kinds = [e["event"] for e in events]
+    assert "hung_step" in kinds and "checkpoint_commit" in kinds
+    sync_saves = [
+        e for e in events if e["event"] == "checkpoint_save" and e["mode"] == "sync"
+    ]
+    assert any(e["reason"] == "preemption" for e in sync_saves)
+
+
+def test_nan_rollback_waits_for_async_commit(tmp_path, mesh):
+    """restore_last_good under async saves: the rollback target is the
+    fully-committed newest checkpoint (the trainer flushes before
+    restoring), never a half-committed one."""
+    plan = FaultPlan().add("nan_loss", epoch=1, step=2)
+    trainer = make_tiny(
+        tmp_path, mesh, nan_policy="restore_last_good", fault_plan=plan,
+        telemetry="on",
+    )
+    trainer.saver.commit_delay_s = 1.0  # epoch 0's commit still in flight
+    trainer.train()
+    assert trainer.nonfinite_steps == 1
+    assert trainer.nonfinite_rollbacks == 1
+    for leaf in jax.tree.leaves(trainer.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()

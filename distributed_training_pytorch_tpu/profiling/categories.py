@@ -1,0 +1,70 @@
+"""The ONE HLO-op categorizer (ISSUE 6 satellite: dedupe).
+
+``scripts/profile_step.py`` used to carry a private ``categorize()``; this is
+that implementation promoted to the shared source of truth, used by the CLI,
+``report.analyze_trace``'s category attribution, and the bench's
+``BENCH_PROFILE`` fields — one bucketing everywhere, so a category line in a
+profile report, a bench JSON, and a ``profile_capture`` event always mean the
+same thing.
+
+Buckets follow where TPU step time actually goes: MXU work (``matmul``,
+``convolution``), VPU elementwise (``fusion(elementwise)``), layout/data
+movement (``copy/transpose``), cross-chip ops (``collective``), host->device
+feed (``infeed``), pooling forward/backward, batch-stat reductions, and
+``other``. ``IDLE`` is not an op category — it is the *absence* of device
+work (gap between programs), attributed by ``report.analyze_trace`` from
+event intervals.
+"""
+
+from __future__ import annotations
+
+__all__ = ["CATEGORIES", "IDLE", "categorize"]
+
+# Every value categorize() can return, in rough "hot on a TPU profile" order.
+CATEGORIES = (
+    "matmul",
+    "convolution",
+    "fusion(elementwise)",
+    "copy/transpose",
+    "collective",
+    "infeed",
+    "pool-forward",
+    "pool-backward",
+    "reduce(stats)",
+    "other",
+)
+
+# The non-op attribution bucket: device wall with no program running
+# (dispatch gaps between consecutive executables). See report.analyze_trace.
+IDLE = "idle"
+
+
+def categorize(name: str) -> str:
+    """Bucket an HLO op name (a trace event name or an HLO text line).
+
+    Every pattern matches the instruction HEAD (the text before `` = ``),
+    never the operand list: a full HLO line like
+    ``%copy.3 = f32[...] copy(%convolution.2)`` is a copy — matching the
+    whole line would let the operand reference inflate the convolution
+    bucket and shrink exactly the copy/transpose bucket the audit exists
+    to expose."""
+    head = name.split(" = ")[0]
+    if "convolution" in head:
+        return "convolution"
+    if "select_and_scatter" in head or "select-and-scatter" in head:
+        return "pool-backward"
+    if "reduce_window" in head or "reduce-window" in head:
+        return "pool-forward"
+    if "all-reduce" in head or "all-gather" in head or "reduce-scatter" in head:
+        return "collective"
+    if "infeed" in head or "outfeed" in head:
+        return "infeed"
+    if "copy" in head or "transpose" in head or "bitcast" in head:
+        return "copy/transpose"
+    if "reduce" in head:  # BN batch statistics, loss reductions
+        return "reduce(stats)"
+    if "fusion" in head:
+        return "fusion(elementwise)"
+    if "dot" in head or "custom-call" in head:
+        return "matmul"
+    return "other"

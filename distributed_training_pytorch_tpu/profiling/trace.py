@@ -1,0 +1,89 @@
+"""Trace capture + headless per-op summaries.
+
+TPU-native analog of the reference's observability hooks — the tqdm live
+progress bars (``/root/reference/trainer/trainer.py:143,186``) and the NCCL
+flight-recorder buffer (``/root/reference/run.sh:8``). On TPU the equivalent
+is an XLA/XProf device trace: ``jax.profiler`` captures per-op device
+timelines (including collective ops), viewable in TensorBoard's profile
+plugin or summarized directly with :func:`top_ops` /
+:func:`~distributed_training_pytorch_tpu.profiling.report.analyze_trace`.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from contextlib import contextmanager
+from typing import Iterator
+
+import jax
+
+from distributed_training_pytorch_tpu.profiling import xplane
+
+__all__ = ["trace", "annotate", "top_ops", "latest_trace_file"]
+
+
+@contextmanager
+def trace(log_dir: str) -> Iterator[str]:
+    """Capture a device+host trace of the enclosed block into ``log_dir``.
+
+    Yields the log dir. The result is a standard XProf/TensorBoard trace
+    (``plugins/profile/<run>/*.xplane.pb``); inspect with TensorBoard,
+    :func:`top_ops`, or ``report.analyze_trace``.
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region (context manager): ``with annotate("train_step"):``.
+
+    Thin alias of ``jax.profiler.TraceAnnotation`` so user code only imports
+    this module.
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def latest_trace_file(log_dir: str) -> str | None:
+    """Path of the newest ``*.xplane.pb`` under ``log_dir`` (or None)."""
+    paths = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def top_ops(
+    log_dir: str, *, limit: int = 20, line: str | None = None
+) -> list[tuple[str, float, int]]:
+    """Summarize the newest trace in ``log_dir``: device ops by total time.
+
+    Returns ``[(op_name, total_time_us, occurrences), ...]`` over the device
+    (TPU/GPU) planes, sorted descending — a headless op profile; no
+    TensorBoard server needed.
+
+    ``line`` filters to one named trace line. The TPU device plane carries
+    several: ``"XLA Ops"`` is the synchronous critical path (its events sum
+    to wall step time), ``"Async XLA Ops"`` holds overlapped DMA/prefetch
+    copies whose durations span their async windows — summing across both
+    double-counts overlap, so per-op accounting should pass
+    ``line="XLA Ops"``. Default (None) keeps every line, preserving the
+    "everything the device did" view.
+    """
+    path = latest_trace_file(log_dir)
+    if path is None:
+        raise FileNotFoundError(f"no *.xplane.pb under {log_dir}")
+    totals: dict[str, list[float]] = {}
+    for plane in xplane.read_trace(path):
+        if "TPU" not in plane.name and "GPU" not in plane.name:
+            continue
+        for trace_line in plane.lines:
+            if line is not None and trace_line.name != line:
+                continue
+            for event in trace_line.events:
+                acc = totals.setdefault(event.name, [0.0, 0])
+                acc[0] += event.duration_ps / 1e6  # ps -> us
+                acc[1] += 1
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])
+    return [(name, round(t, 1), int(n)) for name, (t, n) in ranked[:limit]]

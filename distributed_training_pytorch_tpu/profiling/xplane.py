@@ -1,0 +1,244 @@
+"""Minimal XSpace (``*.xplane.pb``) wire-format codec.
+
+The XProf trace jax.profiler captures is an XSpace protobuf
+(tensorflow/tsl ``xplane.proto``). The pinned tensorboard_plugin_profile's
+generated protos are incompatible with the installed protobuf runtime, so the
+wire format is decoded directly — the schema subset a headless op profile
+needs is tiny:
+
+.. code-block:: none
+
+    XSpace.planes = 1
+    XPlane  { id=1, name=2, lines=3, event_metadata=4 (map<int64, XEventMetadata>) }
+    XLine   { id=1, name=2, timestamp_ns=3, events=4 }
+    XEvent  { metadata_id=1, offset_ps=2, duration_ps=3 }
+    XEventMetadata (map-entry value) { id=1, name=2 }
+
+Durations AND offsets are parsed (the seed parser read durations only), so a
+trace supports *interval* analysis — busy-vs-idle attribution and the
+dispatch-gap audit in :mod:`~.report` — not just per-op totals.
+
+:func:`encode_xspace` is the write-side inverse for the same subset. It exists
+so tests and fixtures can synthesize byte-exact traces with known attribution
+(``tests/test_profiling.py`` checks category fractions against a checked-in
+synthetic ``.xplane.pb`` built with it) — it is not a general XSpace writer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+__all__ = [
+    "TraceEvent",
+    "TraceLine",
+    "TracePlane",
+    "read_trace",
+    "encode_xspace",
+]
+
+
+# -- wire-format primitives ---------------------------------------------------
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, int | bytes]]:
+    """Yield ``(field_number, wire_type, value)`` for one protobuf message.
+
+    A declared payload running past the buffer end raises ``ValueError``
+    (a Python slice would silently truncate it — a torn write would then
+    parse into a confidently wrong partial trace instead of an error)."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = _varint(buf, i)
+        elif wire == 2:
+            ln, i = _varint(buf, i)
+            if i + ln > n:
+                raise ValueError("length-delimited field runs past buffer end")
+            val = buf[i : i + ln]
+            i += ln
+        elif wire == 5:
+            if i + 4 > n:
+                raise ValueError("fixed32 field runs past buffer end")
+            val = buf[i : i + 4]
+            i += 4
+        elif wire == 1:
+            if i + 8 > n:
+                raise ValueError("fixed64 field runs past buffer end")
+            val = buf[i : i + 8]
+            i += 8
+        else:  # groups (3/4) never appear in xplane
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+# -- read side ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEvent:
+    """One timed occurrence of an op/region on a trace line.
+
+    ``start_ps`` as parsed is the line-LOCAL offset (``XEvent.offset_ps`` is
+    relative to its line's ``timestamp_ns``); cross-line interval analysis
+    must rebase by the line's timestamp first (``report._abs_events``)."""
+
+    name: str
+    start_ps: int
+    duration_ps: int
+
+    @property
+    def end_ps(self) -> int:
+        return self.start_ps + self.duration_ps
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceLine:
+    name: str
+    timestamp_ns: int
+    events: tuple[TraceEvent, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TracePlane:
+    name: str
+    lines: tuple[TraceLine, ...]
+
+
+def read_trace(path: str) -> list[TracePlane]:
+    """Parse one ``*.xplane.pb`` into planes -> lines -> timed events.
+
+    Raises ``ValueError`` on truncated/corrupt bytes (a torn write from a
+    crashed profiler, disk-full) — the error type every consumer's
+    analysis-failure net already catches, so a bad trace degrades to a
+    warning instead of killing the run."""
+    with open(path, "rb") as f:
+        space = f.read()
+    try:
+        return _decode_space(space)
+    except (IndexError, ValueError) as e:  # varint/payload past the buffer end
+        raise ValueError(f"{path}: truncated or corrupt xplane bytes") from e
+
+
+def _decode_space(space: bytes) -> list[TracePlane]:
+    planes: list[TracePlane] = []
+    for field, _, plane_buf in _fields(space):
+        if field != 1:  # XSpace.planes
+            continue
+        plane_name, meta_names, line_bufs = "", {}, []
+        for pf, _, pv in _fields(plane_buf):
+            if pf == 2:
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3:
+                line_bufs.append(pv)
+            elif pf == 4:  # map<int64, XEventMetadata> entry
+                mid, mname = 0, ""
+                for ef, _, ev in _fields(pv):
+                    if ef == 2:  # value: XEventMetadata
+                        for mf, _, mv in _fields(ev):
+                            if mf == 1:
+                                mid = mv
+                            elif mf == 2:
+                                mname = mv.decode("utf-8", "replace")
+                meta_names[mid] = mname
+        lines = []
+        for line_buf in line_bufs:
+            line_name, timestamp_ns, events = "", 0, []
+            for lf, _, lv in _fields(line_buf):
+                if lf == 2:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 3:
+                    timestamp_ns = lv
+                elif lf == 4:  # XLine.events
+                    mid = offset_ps = dur_ps = 0
+                    for ef, _, ev in _fields(lv):
+                        if ef == 1:
+                            mid = ev
+                        elif ef == 2:
+                            offset_ps = ev
+                        elif ef == 3:
+                            dur_ps = ev
+                    events.append(
+                        TraceEvent(
+                            name=meta_names.get(mid, f"op#{mid}"),
+                            start_ps=offset_ps,
+                            duration_ps=dur_ps,
+                        )
+                    )
+            lines.append(
+                TraceLine(name=line_name, timestamp_ns=timestamp_ns, events=tuple(events))
+            )
+        planes.append(TracePlane(name=plane_name, lines=tuple(lines)))
+    return planes
+
+
+# -- write side (fixture synthesis) ------------------------------------------
+
+
+def _enc_varint(value: int) -> bytes:
+    if value < 0:
+        # Arithmetic right-shift floors at -1: the loop below would append
+        # 0xFF bytes forever. No XSpace field we synthesize is negative.
+        raise ValueError(f"varint fields must be >= 0, got {value}")
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _enc_field(field: int, wire: int, payload: bytes | int) -> bytes:
+    key = _enc_varint((field << 3) | wire)
+    if wire == 0:
+        return key + _enc_varint(payload)
+    return key + _enc_varint(len(payload)) + payload
+
+
+def encode_xspace(planes: list[dict]) -> bytes:
+    """Encode ``[{name, lines: [{name, timestamp_ns, events: [(op_name,
+    start_ps, duration_ps), ...]}, ...]}, ...]`` into XSpace bytes that
+    :func:`read_trace` (and the seed parser) decode back exactly. Metadata
+    ids are assigned per plane, one per distinct op name."""
+    space = bytearray()
+    for plane in planes:
+        plane_buf = bytearray()
+        plane_buf += _enc_field(2, 2, str(plane["name"]).encode())
+        meta_ids: dict[str, int] = {}
+        for line in plane.get("lines", ()):
+            for op_name, _, _ in line.get("events", ()):
+                meta_ids.setdefault(str(op_name), len(meta_ids) + 1)
+        for line in plane.get("lines", ()):
+            line_buf = bytearray()
+            line_buf += _enc_field(2, 2, str(line["name"]).encode())
+            line_buf += _enc_field(3, 0, int(line.get("timestamp_ns", 0)))
+            for op_name, start_ps, duration_ps in line.get("events", ()):
+                event_buf = (
+                    _enc_field(1, 0, meta_ids[str(op_name)])
+                    + _enc_field(2, 0, int(start_ps))
+                    + _enc_field(3, 0, int(duration_ps))
+                )
+                line_buf += _enc_field(4, 2, bytes(event_buf))
+            plane_buf += _enc_field(3, 2, bytes(line_buf))
+        for op_name, mid in meta_ids.items():
+            meta_buf = _enc_field(1, 0, mid) + _enc_field(2, 2, op_name.encode())
+            entry_buf = _enc_field(1, 0, mid) + _enc_field(2, 2, bytes(meta_buf))
+            plane_buf += _enc_field(4, 2, bytes(entry_buf))
+        space += _enc_field(1, 2, bytes(plane_buf))
+    return bytes(space)

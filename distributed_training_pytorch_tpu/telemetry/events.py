@@ -5,7 +5,11 @@ The framework's narrative observability so far lived in free-text log lines
 "how many preemptions did this run survive?" meant regexing a logfile. The
 event log records the run's *discrete* happenings — run start/end,
 compilation, checkpoint save/restore, preemption, fault injection,
-loss-scale backoff, anomaly — as one JSON object per line, machine-readable
+loss-scale backoff, anomaly, profiling captures (``profile_capture``: trace
+path, traced window, category fractions + dispatch-gap audit, emitted by
+``profiling.StepTraceCapture``), and perf-gate verdicts (``perf_gate``:
+measured vs baseline, tolerance, verdict, emitted by
+``scripts/perf_gate.py``) — as one JSON object per line, machine-readable
 and append-only.
 
 Conventions:

@@ -151,6 +151,11 @@ class TrainEngine:
         # scripts/retrace_guard.py CI gate asserts these stay at 1 per shape,
         # so a dispatch-path change that silently retraces fails fast.
         self.trace_counts: Counter = Counter()
+        # Memoized observability probe executables (compile_step_probe),
+        # keyed by abstract shapes: the MFU probe and the profile capture's
+        # roofline join both want the identical program — one compile serves
+        # both.
+        self._step_probe_cache: dict = {}
 
     def state_sharding(self, state_or_abstract) -> Any:
         """The NamedSharding tree this engine lays state out with.
@@ -510,22 +515,26 @@ class TrainEngine:
             return lowered.compile(compiler_options=dict(compiler_options))
         return lowered.compile()
 
-    def step_cost_analysis(self, state, batch) -> dict:
-        """XLA's cost analysis (FLOPs, bytes accessed, ...) of ONE train step
-        for these shapes — the telemetry MFU probe.
-
-        Compiles a telemetry-only copy of ``_train_step_impl`` (no counting
-        wrapper, no donation) on abstract avals: one extra off-hot-path XLA
-        compile, but the dispatch executables, their jit caches, and
-        ``trace_counts`` are untouched — the retrace-guard contract holds
-        with telemetry on (test-enforced). ``state``/``batch`` may be
-        concrete arrays or ``ShapeDtypeStruct`` trees (no data is read).
-        The scan conventions match ``utils.hlo_flops``: for a chained run
-        this single-step figure IS the per-step figure."""
-        state_sharding = self.state_sharding(state)
+    def compile_step_probe(self, state, batch):
+        """Observability-only compiled copy of ``_train_step_impl`` (no
+        counting wrapper, no donation) on abstract avals: one extra
+        off-hot-path XLA compile, but the dispatch executables, their jit
+        caches, and ``trace_counts`` are untouched — the retrace-guard
+        contract holds with telemetry/profiling on (test-enforced).
+        ``state``/``batch`` may be concrete arrays or ``ShapeDtypeStruct``
+        trees (no data is read). Feeds :meth:`step_cost_analysis` (the MFU
+        probe) and the profile capture's per-op roofline join — memoized per
+        abstract shape, so a run with both telemetry and profiling on pays
+        the probe compile once, not once per consumer."""
         abstract_state, abstract_batch = jax.eval_shape(
             lambda s, b: (s, b), state, batch
         )
+        leaves, treedef = jax.tree.flatten((abstract_state, abstract_batch))
+        key = (treedef, tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves))
+        cached = self._step_probe_cache.get(key)
+        if cached is not None:
+            return cached
+        state_sharding = self.state_sharding(state)
         probe = jax.jit(
             self._train_step_impl,
             in_shardings=(state_sharding, self._batch_sharding),
@@ -533,6 +542,16 @@ class TrainEngine:
         )
         with self._ambient_mesh():
             compiled = probe.lower(abstract_state, abstract_batch).compile()
+        self._step_probe_cache[key] = compiled
+        return compiled
+
+    def step_cost_analysis(self, state, batch) -> dict:
+        """XLA's cost analysis (FLOPs, bytes accessed, ...) of ONE train step
+        for these shapes — the telemetry MFU probe, via
+        :meth:`compile_step_probe`. The scan conventions match
+        ``utils.hlo_flops``: for a chained run this single-step figure IS the
+        per-step figure."""
+        compiled = self.compile_step_probe(state, batch)
         from distributed_training_pytorch_tpu.utils.hlo_flops import xla_cost_analysis
 
         return xla_cost_analysis(compiled)

@@ -43,6 +43,7 @@ Hook mapping (reference -> here):
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal
 import time
@@ -70,6 +71,10 @@ from distributed_training_pytorch_tpu.precision import (
     get_policy,
     is_dynamic,
     resolve_loss_scale,
+)
+from distributed_training_pytorch_tpu.profiling import (
+    StepTraceCapture,
+    resolve_profile,
 )
 from distributed_training_pytorch_tpu.resilience import AsyncCheckpointSaver
 from distributed_training_pytorch_tpu.telemetry import (
@@ -129,6 +134,7 @@ class Trainer:
         precision=None,
         loss_scale=None,
         telemetry=None,
+        profile=None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -157,11 +163,29 @@ class Trainer:
         # epochs; preemption saves still fire regardless.
         self.last_save_period = max(1, int(last_save_period))
         self.cur_epoch = 0
-        # Tracing knob (SURVEY.md §5 tracing entry; analog of the reference's
-        # NCCL flight-recorder buffer, run.sh:8): when set, a jax.profiler
-        # trace of `profile_steps` steady-state steps of the first trained
-        # epoch is written under profile_dir (TensorBoard-loadable; summarize
-        # headlessly with utils.profiling.top_ops).
+        # Tracing knobs. `profile_dir`/`profile_steps` is the legacy surface
+        # (SURVEY.md §5; analog of the reference's NCCL flight-recorder
+        # buffer, run.sh:8): a raw jax.profiler trace of the first epoch's
+        # steady-state steps, forced onto the single-step path. `profile=`
+        # (a profiling.ProfileConfig, or a trace-dir string; ISSUE 6,
+        # docs/profiling.md) is the first-class capture: it traces a window
+        # of the REAL execution (chained windows included), analyzes it into
+        # a StepProfile (device-time attribution + dispatch-gap audit), and
+        # emits a `profile_capture` telemetry event — while keeping the run
+        # bit-exact and trace-count-identical with profile=None
+        # (test-enforced). The two knobs are mutually exclusive: both would
+        # race one global jax.profiler session.
+        self.profile = resolve_profile(profile)
+        if self.profile is not None and profile_dir is not None:
+            raise ValueError(
+                "pass either profile= (ProfileConfig; analyzed capture) or "
+                "profile_dir= (legacy raw trace), not both — they would race "
+                "the one jax.profiler session"
+            )
+        if self.profile is not None and self.profile.dir is None:
+            self.profile = dataclasses.replace(
+                self.profile, dir=os.path.join(save_folder, "profile")
+            )
         self.profile_dir = profile_dir
         self.profile_steps = profile_steps
         self._profiled = False
@@ -323,6 +347,19 @@ class Trainer:
         # Recovery skips (restore_latest_valid walking past a corrupt
         # checkpoint) land in the event log as `checkpoint_rejected` records.
         self.checkpoints.event_log = self.events
+        # Hot-path profiling capture (profiling/capture.py): one traced
+        # window of real steps, driven at unit boundaries in train_epoch.
+        # Rank-0 owned; events no-op when telemetry is off.
+        self._profile_capture = (
+            StepTraceCapture(
+                self.profile,
+                log=self.log,
+                events=self.events,
+                flops_source=self._profile_flops_index,
+            )
+            if self.profile is not None
+            else None
+        )
         # MFU probe bookkeeping: the first executed batch's abstract shapes
         # (ShapeDtypeStructs only — no device ops) feed the one-time
         # engine.step_cost_analysis probe at the end of the first epoch.
@@ -820,6 +857,31 @@ class Trainer:
             flops_per_step=self._flops_per_step,
         )
 
+    def _profile_flops_index(self):
+        """Per-op roofline join table for the profile capture's top-op rows
+        (``profiling.report.flops_index`` over the engine's observability
+        probe — same one-time off-hot-path compile discipline as the MFU
+        probe: dispatch executables and ``trace_counts`` untouched). Returns
+        None (rows carry no FLOPs/bytes) before the first batch's shapes are
+        known or when the probe's module is not the program that was traced:
+        a custom ``train_step`` override, or ``chain_steps > 1`` — the trace
+        then covers the chained-scan executable, whose per-module instruction
+        numbering does not line up with the single-step probe's, and a
+        name-keyed join would attach a DIFFERENT instruction's flops/bytes to
+        a colliding low-numbered name (confidently wrong roofline columns are
+        worse than none)."""
+        if (
+            self._abstract_batch is None
+            or self.chain_steps > 1
+            or type(self).train_step is not Trainer.train_step
+        ):
+            return None
+        from distributed_training_pytorch_tpu.profiling.report import flops_index
+
+        return flops_index(
+            self.engine.compile_step_probe(self.state, self._abstract_batch)
+        )
+
     def _report_anomalies(self, anomalies, *, epoch=None, step_in_epoch=None) -> None:
         """Emit + log each finding; raise when the detector was built with
         ``action="raise"`` (the observability analog of nan_policy='raise')."""
@@ -991,6 +1053,11 @@ class Trainer:
             units = ((1, b) for b in device_prefetch(host_batches, self.mesh))
         bar = self._progress_bar(num_batches, f"epoch {epoch + 1}")
         self._epoch_interrupted = False
+        # Profiling capture (ProfileConfig): a no-op object reference when
+        # off; when on, start/stop transitions fire at unit boundaries so
+        # chained windows are traced whole — execution itself is untouched
+        # (trace_counts + params bit-identical with capture off).
+        cap = self._profile_capture
         watchdog = None
         # The watchdog pats once per executed unit; under chaining a window
         # legitimately takes ~chain step-times, so the timeout scales with it
@@ -1108,16 +1175,20 @@ class Trainer:
                 rollback_fetch = False
                 if self.telemetry is not None:
                     trace_base[0] = sum(self.engine.trace_counts.values())
-                    if self._abstract_batch is None:
-                        # Shapes only (ShapeDtypeStructs, no device ops):
-                        # feeds the one-time MFU probe at epoch end. A window
-                        # leaf [n, B, ...] strips its leading step axis.
-                        self._abstract_batch = jax.tree.map(
-                            lambda x: jax.ShapeDtypeStruct(
-                                x.shape if n == 1 else x.shape[1:], x.dtype
-                            ),
-                            batch,
-                        )
+                if (
+                    self._abstract_batch is None
+                    and (self.telemetry is not None or cap is not None)
+                ):
+                    # Shapes only (ShapeDtypeStructs, no device ops): feeds
+                    # the one-time MFU probe at epoch end and the profile
+                    # capture's roofline join. A window leaf [n, B, ...]
+                    # strips its leading step axis.
+                    self._abstract_batch = jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            x.shape if n == 1 else x.shape[1:], x.dtype
+                        ),
+                        batch,
+                    )
                 if n > 1 and not self._fault_active_in_window(
                     epoch, step_in_epoch, step_in_epoch + n
                 ):
@@ -1130,12 +1201,16 @@ class Trainer:
                         self._preempted = True  # collective (multi-host OR)
                         interrupted = True
                         break
+                    if cap is not None:
+                        cap.maybe_start(step_in_epoch, self.state.params)
                     self.state, window_metrics = self.engine.train_steps_chained(
                         self.state, batch, n
                     )
                     collected.append((n, window_metrics))
                     step_in_epoch += n
                     executed += n
+                    if cap is not None:
+                        cap.maybe_stop(step_in_epoch, self.state.params)
                     watchdog = self._pat_watchdog(watchdog, watchdog_timeout)
                     if bar is not None:
                         bar.update(n)
@@ -1160,10 +1235,14 @@ class Trainer:
                         interrupted = True
                         break
                     self._maybe_profile(step_in_epoch)
+                    if cap is not None:
+                        cap.maybe_start(step_in_epoch, self.state.params)
                     self.state, metrics = self.train_step(self.state, b)
                     collected.append((1, metrics))
                     step_in_epoch += 1
                     executed += 1
+                    if cap is not None:
+                        cap.maybe_stop(step_in_epoch, self.state.params)
                     watchdog = self._pat_watchdog(watchdog, watchdog_timeout)
                     if bar is not None:
                         # Advancing the bar is host-only; the postfix refreshes
@@ -1179,10 +1258,39 @@ class Trainer:
             if interrupted:
                 self._epoch_interrupted = True
                 self._interrupted_at_step = step_in_epoch
+        except BaseException:
+            # An abort with a capture window open (anomaly raise, watchdog
+            # hung-step, nan_policy raise) must still stop the PROCESS-GLOBAL
+            # jax.profiler session — leaving it running would make every
+            # later start_trace in this process fail. sync=None: never block
+            # teardown on (possibly hung) device work; abort=True: never pay
+            # trace analysis or the roofline probe compile ahead of the
+            # emergency-save path. The legacy profile_dir bracket holds the
+            # same process-global session and needs the same teardown.
+            if cap is not None and cap.state == "tracing":
+                cap.maybe_stop(step_in_epoch, None, force=True, abort=True)
+            if self._profiled == "tracing":
+                try:
+                    jax.profiler.stop_trace()
+                except (OSError, RuntimeError):
+                    pass  # teardown: the original exception must propagate
+                self._profiled = True
+            raise
         finally:
             if watchdog is not None:
                 watchdog.stop()
         self._maybe_profile(step_in_epoch, end_of_epoch=True)
+        if cap is not None:  # close a still-open capture window (short epoch)
+            # A preemption-interrupted epoch is on the emergency-save clock:
+            # abort=True skips trace analysis and the roofline probe compile
+            # (same contract as the exception teardown above) — the grace
+            # window is for the checkpoint, not a report.
+            cap.maybe_stop(
+                step_in_epoch,
+                self.state.params,
+                force=True,
+                abort=self._epoch_interrupted,
+            )
         if bar is not None:
             bar.close()
         if not collected:

@@ -71,7 +71,18 @@ def arithmetic_intensity(compiled, *, flops: float | None = None) -> float | Non
         return None
     return numer / denom
 
-DEF_RE = re.compile(r"^(?:ROOT )?%([\w.\-]+) = \w+\[([0-9,]*)\]")
+DEF_RE = re.compile(r"^(?:ROOT )?%([\w.\-]+) = (\w+)\[([0-9,]*)\]")
+
+# Element sizes for the dtypes HLO shapes name; anything unlisted (tuples,
+# opaque tokens) falls back to 4 — per-op bytes are a roofline estimate, not
+# an allocator accounting.
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
 CONV_RE = re.compile(r" convolution\((.*?)\), window={(.*?)}, dim_labels=(\S+?)[,\s]")
 DOT_RE = re.compile(r" dot\((.*?)\),.*?lhs_contracting_dims={([0-9,]*)}")
 OPERAND_RE = re.compile(r"%([\w.\-]+)")
@@ -82,25 +93,44 @@ def _dims(s: str) -> list[int]:
     return [int(x) for x in s.split(",") if x] if s else []
 
 
+def _numel(dims: list[int]) -> int:
+    n = 1
+    for x in dims:
+        n *= x
+    return n
+
+
 def itemize_hlo_matmul_flops(hlo_text: str) -> list[dict]:
     """Per-instruction rows: ``{name, kind, out_elems, reduction, flops,
-    dim_labels, op_name}`` for every conv/dot in the module."""
-    shapes: dict[str, list[int]] = {}
+    bytes, dim_labels, op_name}`` for every conv/dot in the module.
+
+    ``bytes`` is the op's roofline denominator — output write + operand reads
+    at the HLO shapes' dtypes (operands with unparsed shapes contribute 0) —
+    so ``flops / bytes`` places the instruction on the roofline next to the
+    whole-program ``arithmetic_intensity`` figure. Joined into profile
+    reports by ``profiling.report.flops_index``."""
+    shapes: dict[str, tuple[list[int], str]] = {}
     stripped = [line.strip() for line in hlo_text.splitlines()]
     for line in stripped:
         m = DEF_RE.match(line)
         if m:
-            shapes[m.group(1)] = _dims(m.group(2))
+            shapes[m.group(1)] = (_dims(m.group(3)), m.group(2))
+
+    def op_bytes(out_dims: list[int], out_dtype: str, operand_names: list[str]) -> float:
+        total = _numel(out_dims) * DTYPE_BYTES.get(out_dtype, 4)
+        for op in operand_names:
+            if op in shapes:
+                dims, dtype = shapes[op]
+                total += _numel(dims) * DTYPE_BYTES.get(dtype, 4)
+        return float(total)
 
     rows: list[dict] = []
     for line in stripped:
         d = DEF_RE.match(line)
         if not d:
             continue
-        name, out = d.group(1), _dims(d.group(2))
-        out_elems = 1
-        for x in out:
-            out_elems *= x
+        name, out_dtype, out = d.group(1), d.group(2), _dims(d.group(3))
+        out_elems = _numel(out)
         opname = OPNAME_RE.search(line)
         opname = opname.group(1) if opname else ""
         m = CONV_RE.search(line)
@@ -109,6 +139,7 @@ def itemize_hlo_matmul_flops(hlo_text: str) -> list[dict]:
             rhs = shapes.get(ops[1]) if len(ops) > 1 else None
             if rhs is None:
                 continue
+            rhs_dims = rhs[0]
             labels = m.group(3)  # e.g. b01f_01io->b01f
             rhs_spec = labels.split("_")[1].split("-")[0]
             # Reduction per output element = rhs spatial dims x rhs input
@@ -116,12 +147,13 @@ def itemize_hlo_matmul_flops(hlo_text: str) -> list[dict]:
             red = 1
             for pos, ch in enumerate(rhs_spec):
                 if ch.isdigit() or ch == "i":
-                    red *= rhs[pos]
+                    red *= rhs_dims[pos]
             # Grouped convs need NO division here: the HLO rhs kernel's
             # input-feature dim is already C_in/groups (verified on a
             # groups=8 3x3 conv: rhs 'i' dim = 1).
             rows.append(dict(name=name, kind="conv", out_elems=out_elems,
                              reduction=red, flops=2.0 * out_elems * red,
+                             bytes=op_bytes(out, out_dtype, ops[:2]),
                              dim_labels=labels, op_name=opname))
             continue
         m = DOT_RE.search(line)
@@ -132,9 +164,10 @@ def itemize_hlo_matmul_flops(hlo_text: str) -> list[dict]:
                 continue
             red = 1
             for dim in _dims(m.group(2)):
-                red *= lhs[dim]
+                red *= lhs[0][dim]
             rows.append(dict(name=name, kind="dot", out_elems=out_elems,
                              reduction=red, flops=2.0 * out_elems * red,
+                             bytes=op_bytes(out, out_dtype, ops[:2]),
                              dim_labels="", op_name=opname))
     return rows
 

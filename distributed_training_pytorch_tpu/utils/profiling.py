@@ -1,177 +1,16 @@
-"""Tracing / profiling subsystem.
+"""Back-compat shim: the tracing/profiling surface moved to the first-class
+``profiling/`` package (ISSUE 6) — trace capture, the xplane codec, category
+attribution, ``StepProfile`` reports, hot-path capture, and the perf gate all
+live there now. Existing ``utils.profiling`` imports keep working.
 
-TPU-native analog of the reference's observability hooks — the tqdm live
-progress bars (``/root/reference/trainer/trainer.py:143,186``) and the NCCL
-flight-recorder buffer (``/root/reference/run.sh:8``, 100 MiB
-``TORCH_NCCL_TRACE_BUFFER_SIZE`` for post-mortem collective traces). On TPU the
-equivalent is an XLA/XProf device trace: ``jax.profiler`` captures per-op
-device timelines (including collective ops), viewable in TensorBoard's profile
-plugin or summarized directly with :func:`top_ops`.
-
-Surface:
-
-* :func:`trace` — context manager around ``jax.profiler.start_trace`` /
-  ``stop_trace``; writes a TensorBoard-loadable trace under ``log_dir``.
-* :func:`annotate` — named region inside a trace (shows up on the host
-  timeline; use around step phases: data load, step, checkpoint).
-* :func:`top_ops` — parse the newest captured trace into a list of
-  ``(op_name, self_time_us, occurrences)`` sorted by device self-time, so a
-  trace can be inspected headlessly (no TensorBoard UI needed).
-* ``Trainer(profile_dir=...)`` (see ``trainer/trainer.py``) traces a window of
-  training steps automatically.
+New code should import ``distributed_training_pytorch_tpu.profiling``.
 """
 
-from __future__ import annotations
-
-import glob
-import gzip
-import os
-from contextlib import contextmanager
-from typing import Iterator
-
-import jax
+from distributed_training_pytorch_tpu.profiling.trace import (  # noqa: F401
+    annotate,
+    latest_trace_file,
+    top_ops,
+    trace,
+)
 
 __all__ = ["trace", "annotate", "top_ops", "latest_trace_file"]
-
-
-@contextmanager
-def trace(log_dir: str) -> Iterator[str]:
-    """Capture a device+host trace of the enclosed block into ``log_dir``.
-
-    Yields the log dir. The result is a standard XProf/TensorBoard trace
-    (``plugins/profile/<run>/*.xplane.pb``); inspect with TensorBoard or
-    :func:`top_ops`.
-    """
-    os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
-    try:
-        yield log_dir
-    finally:
-        jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Named trace region (context manager): ``with annotate("train_step"):``.
-
-    Thin alias of ``jax.profiler.TraceAnnotation`` so user code only imports
-    this module.
-    """
-    return jax.profiler.TraceAnnotation(name)
-
-
-def latest_trace_file(log_dir: str) -> str | None:
-    """Path of the newest ``*.xplane.pb`` under ``log_dir`` (or None)."""
-    paths = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
-    return max(paths, key=os.path.getmtime) if paths else None
-
-
-# -- minimal xplane.pb reader -------------------------------------------------
-# The XProf trace is an XSpace protobuf (tensorflow/tsl xplane.proto). The
-# pinned tensorboard_plugin_profile's generated protos are incompatible with
-# the installed protobuf runtime, so decode the wire format directly — the
-# schema subset needed for an op table is tiny:
-#   XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4(map)}
-#   XLine{name=2, events=4} / XEvent{metadata_id=1, duration_ps=3}
-#   XEventMetadata(map entry value){id=1, name=2}
-
-
-def _varint(buf: bytes, i: int) -> tuple[int, int]:
-    shift = result = 0
-    while True:
-        b = buf[i]
-        i += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, i
-        shift += 7
-
-
-def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) for one protobuf message."""
-    i, n = 0, len(buf)
-    while i < n:
-        key, i = _varint(buf, i)
-        field, wire = key >> 3, key & 7
-        if wire == 0:
-            val, i = _varint(buf, i)
-        elif wire == 2:
-            ln, i = _varint(buf, i)
-            val = buf[i : i + ln]
-            i += ln
-        elif wire == 5:
-            val = buf[i : i + 4]
-            i += 4
-        elif wire == 1:
-            val = buf[i : i + 8]
-            i += 8
-        else:  # groups (3/4) never appear in xplane
-            raise ValueError(f"unsupported wire type {wire}")
-        yield field, wire, val
-
-
-def top_ops(
-    log_dir: str, *, limit: int = 20, line: str | None = None
-) -> list[tuple[str, float, int]]:
-    """Summarize the newest trace in ``log_dir``: device ops by total time.
-
-    Returns ``[(op_name, total_time_us, occurrences), ...]`` over the device
-    (TPU/GPU) planes, sorted descending — a headless op profile; no
-    TensorBoard server needed.
-
-    ``line`` filters to one named trace line. The TPU device plane carries
-    several: ``"XLA Ops"`` is the synchronous critical path (its events sum
-    to wall step time), ``"Async XLA Ops"`` holds overlapped DMA/prefetch
-    copies whose durations span their async windows — summing across both
-    double-counts overlap, so per-op accounting should pass
-    ``line="XLA Ops"``. Default (None) keeps every line, preserving the
-    "everything the device did" view.
-    """
-    path = latest_trace_file(log_dir)
-    if path is None:
-        raise FileNotFoundError(f"no *.xplane.pb under {log_dir}")
-    with open(path, "rb") as f:
-        space = f.read()
-    totals: dict[str, list[float]] = {}
-    for field, _, plane_buf in _fields(space):
-        if field != 1:  # XSpace.planes
-            continue
-        plane_name, meta_names, lines = "", {}, []
-        for pf, _, pv in _fields(plane_buf):
-            if pf == 2:
-                plane_name = pv.decode("utf-8", "replace")
-            elif pf == 3:
-                lines.append(pv)
-            elif pf == 4:  # map<int64, XEventMetadata> entry
-                mid, mname = 0, ""
-                for ef, _, ev in _fields(pv):
-                    if ef == 2:  # value: XEventMetadata
-                        for mf, _, mv in _fields(ev):
-                            if mf == 1:
-                                mid = mv
-                            elif mf == 2:
-                                mname = mv.decode("utf-8", "replace")
-                meta_names[mid] = mname
-        if "TPU" not in plane_name and "GPU" not in plane_name:
-            continue
-        for line_buf in lines:
-            line_name, events = "", []
-            for lf, _, lv in _fields(line_buf):
-                if lf == 2:
-                    line_name = lv.decode("utf-8", "replace")
-                elif lf == 4:  # XLine.events
-                    events.append(lv)
-            if line is not None and line_name != line:
-                continue
-            for lv in events:
-                mid = dur_ps = 0
-                for ef, _, ev in _fields(lv):
-                    if ef == 1:
-                        mid = ev
-                    elif ef == 3:
-                        dur_ps = ev
-                name = meta_names.get(mid, f"op#{mid}")
-                acc = totals.setdefault(name, [0.0, 0])
-                acc[0] += dur_ps / 1e6  # ps -> us
-                acc[1] += 1
-    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])
-    return [(name, round(t, 1), int(n)) for name, (t, n) in ranked[:limit]]

@@ -1,10 +1,13 @@
 """Profile a compiled train step: headless per-op device-time table.
 
-Builds the exact executable ``bench.py`` times (same model registry, batch,
-compiler options), runs a traced window, and prints the top device ops by
-self-time plus a category rollup (conv fwd / dgrad / wgrad, fusions, copies,
-BN-ish elementwise, all-else). This is the profile-first tool the zoo-config
-perf work runs before touching any model (VERDICT r3 items 1/3/6).
+Thin CLI over ``distributed_training_pytorch_tpu.profiling`` (ISSUE 6): builds
+the exact executable ``bench.py`` times (same model registry, batch, compiler
+options), runs a traced window, and prints ``report.analyze_trace``'s
+attribution — busy/idle split, category rollup (conv / matmul / fusions /
+copies / collectives / reduce / idle), and the top-op table joined with
+per-op FLOPs + bytes + arithmetic intensity (roofline position). The
+categorizer and the report are the package's — one source of truth shared
+with ``Trainer(profile=...)`` captures and bench's ``BENCH_PROFILE`` fields.
 
 Usage:  BENCH_MODEL=resnet50 python scripts/profile_step.py
 Env:    PROFILE_STEPS (default 3 traced steps), PROFILE_LIMIT (table rows),
@@ -19,30 +22,14 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench
-from distributed_training_pytorch_tpu.utils.profiling import top_ops, trace
+from distributed_training_pytorch_tpu.profiling import (
+    IDLE,
+    analyze_trace,
+    flops_index,
+    top_ops,
+    trace,
+)
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
-
-
-def categorize(name: str) -> str:
-    """Bucket an HLO op name from the critical-path trace line."""
-    head = name.split(" = ")[0]
-    if "convolution" in name:
-        return "convolution"
-    if "select_and_scatter" in name or "select-and-scatter" in name:
-        return "pool-backward"
-    if "reduce_window" in name or "reduce-window" in name:
-        return "pool-forward"
-    if "all-reduce" in name or "all-gather" in name or "reduce-scatter" in name:
-        return "collective"
-    if "copy" in head or "transpose" in head or "bitcast" in head:
-        return "copy/transpose"
-    if "reduce" in head:  # BN batch statistics, loss reductions
-        return "reduce(stats)"
-    if "fusion" in head:
-        return "fusion(elementwise)"
-    if "dot" in head or "custom-call" in head:
-        return "matmul"
-    return "other"
 
 
 def main():
@@ -69,29 +56,37 @@ def main():
             state, m = compiled(state, gbatch)
         _ = float(m["loss"])
 
-    # "XLA Ops" is the synchronous critical path: its events sum to wall step
-    # time. (The "Async XLA Ops" line holds overlapped DMA windows — summing
-    # it in would double-count; see utils/profiling.top_ops docstring.)
-    op_rows = top_ops(log_dir, limit=2000, line="XLA Ops")
-    op_total = sum(t for _, t, _ in op_rows)
-    async_rows = top_ops(log_dir, limit=2000, line="Async XLA Ops")
-    async_total = sum(t for _, t, _ in async_rows)
+    report = analyze_trace(
+        log_dir, steps=steps, top_k=limit, flops_by_op=flops_index(compiled)
+    )
+    # The device "Async XLA Ops" line holds overlapped DMA windows — outside
+    # the report's critical-path attribution (summing it in would
+    # double-count overlap) but worth a line: it is the H2D/prefetch story.
+    async_total = sum(t for _, t, _ in top_ops(log_dir, limit=2000, line="Async XLA Ops"))
 
     print(f"# profile: {model_name} batch={batch} size={image_size} "
-          f"steps={steps} (trace {log_dir})")
-    print(f"# critical path (XLA Ops line): {op_total/1e3:.2f} ms over {steps} steps "
-          f"= {op_total/1e3/steps:.2f} ms/step  |  async DMA windows "
-          f"(overlapped): {async_total/1e3:.2f} ms")
-    cats: dict[str, float] = {}
-    for name, t, _ in op_rows:
-        cats[categorize(name)] = cats.get(categorize(name), 0.0) + t
-    print("\n## category rollup (self-time)")
-    for cat, t in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"  {cat:12s} {t/1e3:9.2f} ms  {100*t/op_total:5.1f}%")
-    print(f"\n## top {limit} ops")
-    for name, t, n in op_rows[:limit]:
-        short = re.sub(r"\s+", " ", name)[:160]
-        print(f"  {t/1e3:8.2f} ms  x{n:<4d} {100*t/op_total:5.1f}%  {short}")
+          f"steps={steps} (trace {report.trace_path})")
+    print(f"# {report.summary()}")
+    print(f"# source: {report.source}; busy {report.busy_us/1e3:.2f} ms + idle "
+          f"{report.idle_us/1e3:.2f} ms over {report.span_us/1e3:.2f} ms span"
+          + (f" = {report.step_us/1e3:.2f} ms/step" if report.step_us else "")
+          + (f"  |  async DMA windows (overlapped): {async_total/1e3:.2f} ms"
+             if async_total else ""))
+    print("\n## category attribution (fractions of span, sum = 1)")
+    for cat, frac in sorted(report.categories.items(), key=lambda kv: -kv[1]):
+        us = report.category_us.get(cat, report.idle_us if cat == IDLE else 0.0)
+        print(f"  {cat:20s} {us/1e3:9.2f} ms  {100*frac:5.1f}%")
+    print(f"\n## top {limit} ops (self-time; flops/bytes/intensity where the "
+          "HLO walk itemizes them)")
+    for row in report.top_ops:
+        short = re.sub(r"\s+", " ", row.name)[:120]
+        roofline = (
+            f"  [{row.flops:.3g} flop / {row.bytes:.3g} B = {row.arith_intensity:.1f} F/B]"
+            if row.arith_intensity is not None
+            else ""
+        )
+        print(f"  {row.total_us/1e3:8.2f} ms  x{row.count:<4d} "
+              f"{100*row.frac_busy:5.1f}%  {short}{roofline}")
 
 
 if __name__ == "__main__":

@@ -15,7 +15,11 @@ scan outputs), then asserts the subsystem's core contracts:
   fail here in seconds, not as a nonsense dashboard on real hardware);
 * the on-device **train-health stats** came back through the epoch metrics
   (grad_norm / param_norm / update_ratio finite, nonfinite == 0) without
-  disturbing the retrace contract (chained executable traced exactly once).
+  disturbing the retrace contract (chained executable traced exactly once);
+* the run is traced with ``profile=ProfileConfig(steps=2)`` (ISSUE 6): the
+  capture completes on a real digits run, its ``StepProfile`` **category
+  fractions sum to 1 ± ε**, and the ``profile_capture`` event lands in the
+  log with the attribution summary.
 
 Fails fast (nonzero exit) so ``scripts/verify.sh`` catches observability
 regressions the way the retrace/precision gates catch theirs.
@@ -34,6 +38,7 @@ from flax import linen as nn
 
 from distributed_training_pytorch_tpu.data import ArrayDataSource
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.profiling import ProfileConfig
 from distributed_training_pytorch_tpu.telemetry import read_events
 from distributed_training_pytorch_tpu.trainer import Trainer
 
@@ -95,6 +100,7 @@ def main() -> int:
             batch_size=128,
             save_folder=tmp,
             telemetry="on",
+            profile=ProfileConfig(steps=2),
             chain_steps=2,
             log_every=4,
             num_workers=0,
@@ -157,6 +163,25 @@ def main() -> int:
                 f"chained executable retraced with telemetry on: "
                 f"{dict(trainer.engine.trace_counts)}"
             )
+
+        # -- profile capture on the real digits run (ISSUE 6) ---------------
+        cap = trainer._profile_capture
+        if cap is None or cap.state != "done" or cap.steps_traced < 2:
+            errors.append(f"profile capture did not complete: {cap and cap.state}")
+        if cap is not None and cap.report is None:
+            errors.append("profile capture produced no StepProfile report")
+        elif cap is not None:
+            cat_sum = sum(cap.report.categories.values())
+            if abs(cat_sum - 1.0) > 1e-6:
+                errors.append(
+                    f"StepProfile category fractions sum to {cat_sum!r}, not 1: "
+                    f"{cap.report.categories}"
+                )
+        captures = [rec for rec in events if rec.get("event") == "profile_capture"]
+        if len(captures) != 1:
+            errors.append(f"expected exactly 1 profile_capture event, got {len(captures)}")
+        elif "categories" not in captures[0]:
+            errors.append(f"profile_capture event carries no attribution: {captures[0]}")
 
         if errors:
             print("TELEMETRY SMOKE FAILED:", file=sys.stderr)

@@ -19,7 +19,10 @@
 #
 # Stage 4 is a short CPU digits run with telemetry="on" asserting the event
 # log is well-formed JSONL, goodput bucket fractions sum to 1 +- eps, and the
-# on-device health stats rode the chained windows without a retrace.
+# on-device health stats rode the chained windows without a retrace. The run
+# is also traced with profile=ProfileConfig (ISSUE 6): the capture must
+# complete, its StepProfile category fractions must sum to 1 +- eps, and the
+# profile_capture event must land in the log.
 #
 # Stage 5 is the chaos soak in --quick mode: a real digits training job killed
 # 3 times (graceful SIGTERM, SIGKILL mid-background-commit, SIGKILL mid-
@@ -28,12 +31,19 @@
 # uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
 # save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
 #
-# Stage 6 is the ROADMAP.md tier-1 command verbatim.
+# Stage 6 is the perf-regression gate (docs/profiling.md): a ~10s CPU
+# measurement of the real chained-engine path, gated as a machine-portable
+# calibrated ratio against the committed PERF_BASELINE.json — a step-time
+# regression past tolerance (an accidental retrace, a lost chained dispatch
+# path) fails here. The gate's own teeth are tested on every run: a
+# deliberate 3x injected slowdown must make it FAIL.
+#
+# Stage 7 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/6: import health (pytest --collect-only) =="
+echo "== stage 1/7: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -42,31 +52,43 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/6: chained-dispatch retrace guard =="
+echo "== stage 2/7: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 3
 fi
 
-echo "== stage 3/6: mixed-precision smoke (bf16 digits) =="
+echo "== stage 3/7: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 4
 fi
 
-echo "== stage 4/6: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 4/7: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 5
 fi
 
-echo "== stage 5/6: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 5/7: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 6
 fi
 
-echo "== stage 6/6: tier-1 test suite =="
+echo "== stage 6/7: perf-regression gate (clean + injected-slowdown self-test) =="
+if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
+  echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
+  echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
+  exit 7
+fi
+if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; then
+  echo "PERF GATE SELF-TEST FAILED — a 3x injected regression PASSED the gate"
+  exit 7
+fi
+echo "perf_gate self-test OK: injected 3x regression correctly failed"
+
+echo "== stage 7/7: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

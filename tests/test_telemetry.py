@@ -436,6 +436,22 @@ def test_step_cost_analysis_probe_leaves_trace_counts_alone(devices):
     assert dict(engine.trace_counts) == before
 
 
+def test_compile_step_probe_memoized_per_shape(devices):
+    """Telemetry's MFU probe and profiling's roofline join share one probe
+    compile: same abstract shapes must return the cached executable, a new
+    batch shape must compile fresh."""
+    engine, state = make_engine()
+    batch = engine.shard_batch(synthetic_batch(16, seed=4))
+    first = engine.compile_step_probe(state, batch)
+    abstract_batch = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch
+    )
+    assert engine.compile_step_probe(state, abstract_batch) is first
+    assert engine.compile_step_probe(state, batch) is first
+    other = engine.shard_batch(synthetic_batch(32, seed=4))
+    assert engine.compile_step_probe(state, other) is not first
+
+
 # ---------------------------------------------------------------------------
 # Trainer integration: a tiny Dense trainer (compile cost: seconds).
 

@@ -30,6 +30,10 @@ offline evaluator — rebuilt TPU-first:
 * ``telemetry`` — run observability: structured JSONL event log, goodput
   wall-time buckets (cumulative across kill/resume), on-device train-health
   stats, MFU/roofline fields, anomaly detectors (docs/observability.md).
+* ``analysis``  — static analysis: jaxlint (project-specific AST rules with
+  audited inline waivers), compiled-program HLO audit (donation aliasing,
+  precision leaks, host callbacks), generic ruff/stdlib layer
+  (docs/static_analysis.md; gate: ``scripts/static_audit.py``).
 * ``compat``    — JAX version shims (``shard_map`` API move, ambient-mesh
   helpers) so one codebase spans the supported JAX range.
 * ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.

@@ -1,0 +1,67 @@
+"""Static analysis subsystem (ISSUE 7; docs/static_analysis.md).
+
+Three complementary layers, all wired into ``scripts/static_audit.py`` and
+run as a ``scripts/verify.sh`` gate:
+
+* ``analysis.generic`` — generic hygiene (ruff when installed, a stdlib
+  fallback with syntax + unused-import checks otherwise);
+* ``analysis.lint`` — **jaxlint**, AST rules for the JAX-specific bug
+  classes this repo has actually shipped (host syncs in compiled regions,
+  un-rank-gated file writes, unlocked cross-thread mutation, wall-clock in
+  jitted code, bare excepts, undonated state jits), with audited inline
+  waivers (``analysis.waivers``);
+* ``analysis.hlo_audit`` — invariants checked on the *compiled/lowered*
+  programs themselves: full param/opt-state buffer donation, no fp32 MXU
+  ops under a low-precision policy, no host callbacks in chained windows.
+"""
+
+from distributed_training_pytorch_tpu.analysis.generic import (
+    GenericFinding,
+    GenericReport,
+    run_generic,
+    ruff_available,
+)
+from distributed_training_pytorch_tpu.analysis.hlo_audit import (
+    CallbackReport,
+    DonationReport,
+    HloAuditReport,
+    PrecisionReport,
+    audit_donation,
+    audit_host_callbacks,
+    audit_precision_leaks,
+    build_audit_engine,
+    parse_input_output_aliases,
+    run_hlo_audit,
+)
+from distributed_training_pytorch_tpu.analysis.lint import (
+    RULES,
+    Finding,
+    LintResult,
+    lint_paths,
+    lint_source,
+)
+from distributed_training_pytorch_tpu.analysis.waivers import Waiver, scan_waivers
+
+__all__ = [
+    "GenericFinding",
+    "GenericReport",
+    "run_generic",
+    "ruff_available",
+    "CallbackReport",
+    "DonationReport",
+    "HloAuditReport",
+    "PrecisionReport",
+    "audit_donation",
+    "audit_host_callbacks",
+    "audit_precision_leaks",
+    "build_audit_engine",
+    "parse_input_output_aliases",
+    "run_hlo_audit",
+    "RULES",
+    "Finding",
+    "LintResult",
+    "lint_paths",
+    "lint_source",
+    "Waiver",
+    "scan_waivers",
+]

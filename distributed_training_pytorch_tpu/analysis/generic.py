@@ -1,0 +1,187 @@
+"""Generic lint layer: ruff when available, a stdlib fallback otherwise.
+
+jaxlint (``analysis.lint``) carries only project-specific rules; the
+generic hygiene layer (pyflakes/pycodestyle-class checks, import sorting)
+belongs to ``ruff``, configured in ``pyproject.toml`` ``[tool.ruff]`` so
+every environment that has it runs the same rule set. Hermetic CI images
+that do not ship ruff still get a floor: a stdlib fallback that catches the
+two highest-value F-class defects with zero dependencies —
+
+* **syntax errors** (a module that cannot parse fails here in milliseconds
+  instead of as a collection error ten minutes into tier-1), and
+* **unused module-level imports** (F401): dead imports are where stale
+  dependencies hide, and the one generic defect class that creeps back
+  weekly without a gate.
+
+The fallback honors ``# noqa`` on the import's line (the same escape ruff
+uses) and skips ``__init__.py`` re-export modules, mirroring the
+``per-file-ignores`` in pyproject — the two layers must agree on what
+clean means or the gate would flap depending on which machine ran it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+from typing import Iterable
+
+__all__ = ["GenericFinding", "GenericReport", "run_generic", "ruff_available"]
+
+
+@dataclasses.dataclass
+class GenericFinding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def describe(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclasses.dataclass
+class GenericReport:
+    findings: list[GenericFinding]
+    tool: str  # "ruff" or "builtin"
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def ruff_available() -> bool:
+    return shutil.which("ruff") is not None
+
+
+def _python_files(paths: Iterable[str]) -> list[str]:
+    files: list[str] = []
+    for root in paths:
+        if os.path.isdir(root):
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                ]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames
+                    if f.endswith(".py")
+                )
+        elif root.endswith(".py"):
+            files.append(root)
+    return sorted(files)
+
+
+def _run_ruff(paths: list[str]) -> GenericReport:
+    proc = subprocess.run(
+        ["ruff", "check", "--output-format", "json", *paths],
+        capture_output=True,
+        text=True,
+        check=False,
+    )
+    findings: list[GenericFinding] = []
+    try:
+        rows = json.loads(proc.stdout or "[]")
+    except json.JSONDecodeError:
+        rows = []
+        if proc.returncode not in (0, 1):
+            findings.append(
+                GenericFinding(
+                    path="<ruff>", line=0, code="RUFF",
+                    message=f"ruff failed: {proc.stderr.strip()[:200]}",
+                )
+            )
+    for row in rows:
+        findings.append(
+            GenericFinding(
+                path=os.path.relpath(row.get("filename", "?")),
+                line=int((row.get("location") or {}).get("row", 0)),
+                code=str(row.get("code")),
+                message=str(row.get("message")),
+            )
+        )
+    return GenericReport(findings=findings, tool="ruff")
+
+
+def _unused_imports(tree: ast.Module, source: str, path: str) -> list[GenericFinding]:
+    lines = source.splitlines()
+
+    def noqa(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+
+    imported: dict[str, tuple[int, str]] = {}  # bound name -> (line, shown)
+    for node in tree.body:  # module level only: locals are ruff's business
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                imported[bound] = (node.lineno, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directives, never "used" by name
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                # `import x as x` is the explicit re-export idiom — keep.
+                if alias.asname is not None and alias.asname == alias.name:
+                    continue
+                bound = alias.asname or alias.name
+                imported[bound] = (node.lineno, alias.name)
+    if not imported:
+        return []
+    # Any Name reference counts as use (an Attribute's root Name is reached
+    # by the same walk). String mentions do NOT count — except __all__
+    # entries below, the one string convention that genuinely re-exports.
+    used: set[str] = {
+        node.id for node in ast.walk(tree) if isinstance(node, ast.Name)
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    used.add(sub.value)
+    findings = []
+    for bound, (lineno, shown) in imported.items():
+        if bound in used or noqa(lineno):
+            continue
+        findings.append(
+            GenericFinding(
+                path=path, line=lineno, code="F401",
+                message=f"{shown!r} imported but unused",
+            )
+        )
+    return findings
+
+
+def _run_builtin(files: list[str]) -> GenericReport:
+    findings: list[GenericFinding] = []
+    for file in files:
+        with open(file, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=file)
+        except SyntaxError as e:
+            findings.append(
+                GenericFinding(
+                    path=file, line=e.lineno or 0, code="E999",
+                    message=f"syntax error: {e.msg}",
+                )
+            )
+            continue
+        if os.path.basename(file) == "__init__.py":
+            continue  # re-export modules: per-file-ignores F401 (pyproject)
+        findings.extend(_unused_imports(tree, source, file))
+    return GenericReport(findings=findings, tool="builtin")
+
+
+def run_generic(paths: Iterable[str]) -> GenericReport:
+    """Run the generic layer over files/directories: ruff with the repo
+    config when installed, the stdlib fallback otherwise."""
+    files = _python_files(paths)
+    if ruff_available():
+        return _run_ruff(files)
+    return _run_builtin(files)

@@ -1,0 +1,640 @@
+"""jaxlint — AST lint rules for bugs this codebase has actually shipped.
+
+Generic linters cannot see JAX's failure modes: a ``float()`` that is free
+host code everywhere else is a device sync inside a compiled region; an
+``open(..., "w")`` that is fine in a script double-writes from N hosts in a
+training job; a counter bumped from a background thread is invisible until a
+chaos soak catches the torn read. Each rule here is grounded in a bug a past
+PR fixed after the fact (docs/static_analysis.md carries the full catalog
+with the history):
+
+``host-sync-in-step``
+    ``.item()`` / ``float()`` / ``int()`` / ``np.asarray`` /
+    ``jax.device_get`` on traced values inside a compiled region. The
+    engine's whole design keeps metrics device-resident (the reference paid
+    a ``loss.item()`` sync per step); one of these in a step fn silently
+    reintroduces that per-step stall.
+``wall-clock-in-step``
+    ``time.time()`` / ``time.monotonic()`` / ``datetime.now()`` inside a
+    compiled region: the value freezes at trace time, so the program bakes
+    in one timestamp — and any data-dependent use breaks the bit-exact
+    resume invariant (a resumed trace sees a different constant).
+``file-write-without-rank-gate``
+    ``open()`` for write with no ``process_index() == 0`` gate in sight
+    (the ``utils/logger`` convention): N hosts interleaving half-lines on a
+    shared filesystem, the exact failure the EventLog's rank-0 ownership
+    exists to prevent.
+``cross-thread-mutation-without-lock``
+    an attribute mutated from a ``threading.Thread`` target (or a method it
+    calls) outside any ``with self.<lock>:`` block, on an object the main
+    thread shares — the PR 5 EventLog t_mono regression, and the
+    async-saver counter races this PR fixes.
+``bare-except``
+    ``except:`` catches ``KeyboardInterrupt``/``SystemExit``; a Ctrl-C'd
+    run that keeps going (or a swallowed watchdog exit) is a hang with
+    extra steps. ``except Exception`` is the correct broad form.
+``missing-donate-on-jit``
+    a ``jax.jit`` whose function carries a state-named first parameter with
+    no ``donate_argnums``: the optimizer state's old buffers stay live
+    across the update, doubling state memory — the ROADMAP item 3
+    donation-audit concern, at the source level (``analysis.hlo_audit``
+    checks the same invariant on the compiled program).
+
+Static analysis is heuristic; false positives are waived inline —
+``# jaxlint: disable=<rule> -- <reason>`` (``analysis.waivers``) — and every
+waiver is counted and printed by ``scripts/static_audit.py``.
+
+Scope notes (documented limitations, by design small): compiled regions are
+resolved per module (a cross-module callee of a jitted fn is linted in its
+own module's context); thread targets are resolved for ``self.<method>``
+targets within a class; any ``with self.<attr>:`` counts as holding a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator
+
+from distributed_training_pytorch_tpu.analysis.waivers import Waiver, scan_waivers
+
+__all__ = ["Finding", "LintResult", "RULES", "lint_source", "lint_paths"]
+
+RULES = {
+    "host-sync-in-step": "host sync (.item()/float()/int()/np.asarray/"
+    "device_get) inside a compiled region",
+    "wall-clock-in-step": "wall-clock read (time.time/datetime.now) inside "
+    "a compiled region",
+    "file-write-without-rank-gate": "open() for write without a "
+    "process_index == 0 gate (utils/logger convention)",
+    "cross-thread-mutation-without-lock": "attribute mutated from a thread "
+    "target without holding a lock",
+    "bare-except": "bare except: swallows KeyboardInterrupt/SystemExit",
+    "missing-donate-on-jit": "state-carrying jax.jit without donate_argnums",
+    "waiver-missing-reason": "jaxlint disable comment without a '-- reason'",
+}
+
+# Call names whose function-argument(s) are traced into a compiled program:
+# (name, positional indices of function args).
+_COMPILED_ROOT_CALLS = {
+    "jit": (0,),
+    "pjit": (0,),
+    "scan": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "cond": (1, 2),
+    "while_loop": (0, 1),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+}
+
+_STATE_PARAM_NAMES = {"state", "st", "carry", "train_state"}
+
+_WALL_CLOCK_TIME_ATTRS = {
+    "time", "monotonic", "perf_counter", "process_time", "thread_time",
+    "monotonic_ns", "perf_counter_ns", "time_ns",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    waiver_reason: str | None = None
+
+    def describe(self) -> str:
+        tag = f"  [waived: {self.waiver_reason}]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    waivers: list[Waiver]
+
+    @property
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def unused_waivers(self) -> list[Waiver]:
+        return [w for w in self.waivers if not w.used]
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def merge(self, other: "LintResult") -> "LintResult":
+        return LintResult(
+            self.findings + other.findings, self.waivers + other.waivers
+        )
+
+
+# -- small AST helpers ------------------------------------------------------
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    """The rightmost identifier of a Name/Attribute chain (``jax.lax.scan``
+    -> ``scan``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _identifiers(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _is_self_attribute(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    )
+
+
+def _walk_skipping_defs(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class defs
+    (each def is visited on its own, so rules fire once per site)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue  # never descend — the def is visited on its own
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _rankish(name: str) -> bool:
+    low = name.lower()
+    return low.startswith("proc") or "process" in low or "rank" in low
+
+
+def _is_rank_gate(test: ast.AST) -> bool:
+    """A test expression that gates on 'am I the writing process': a compare
+    of a proc/rank-ish identifier against 0, a truthiness check of an
+    ``enabled`` flag, or a call to something named like ``process_index``."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            operands = [node.left, *node.comparators]
+            has_zero = any(
+                isinstance(op, ast.Constant) and op.value == 0 for op in operands
+            )
+            if has_zero and any(
+                _rankish(ident)
+                for op in operands
+                for ident in _identifiers(op)
+            ):
+                return True
+        name = _terminal_name(node)
+        if name == "enabled" or (name is not None and "process_index" in name):
+            return True
+        if isinstance(node, ast.Call):
+            called = _terminal_name(node.func) or ""
+            if "is_coordinator" in called or "is_rank" in called.lower():
+                return True
+    return False
+
+
+# -- the per-module analyzer ------------------------------------------------
+
+
+class _ModuleLint:
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.findings: list[Finding] = []
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # name -> defs with that bare name, anywhere in the module.
+        self.defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule=rule, path=self.path, line=node.lineno, message=message)
+        )
+
+    # -- compiled-region resolution ------------------------------------
+
+    def _resolve_fn_arg(self, node: ast.AST) -> list[ast.AST]:
+        """Function nodes an argument expression may refer to: a local def by
+        name, a ``self.X`` method by name, or a literal lambda."""
+        if isinstance(node, ast.Lambda):
+            return [node]
+        name = _terminal_name(node)
+        if name is not None:
+            return list(self.defs.get(name, ()))
+        if isinstance(node, ast.Call):
+            # functools.partial(f, ...) — unwrap to f.
+            if _terminal_name(node.func) == "partial" and node.args:
+                return self._resolve_fn_arg(node.args[0])
+        return []
+
+    def compiled_regions(self) -> set[ast.AST]:
+        roots: set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                called = _terminal_name(node.func)
+                indices = _COMPILED_ROOT_CALLS.get(called or "")
+                if indices:
+                    for i in indices:
+                        if i < len(node.args):
+                            roots.update(self._resolve_fn_arg(node.args[i]))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    target = deco.func if isinstance(deco, ast.Call) else deco
+                    if isinstance(deco, ast.Call) and _terminal_name(target) == "partial":
+                        if deco.args and _terminal_name(deco.args[0]) == "jit":
+                            roots.add(node)
+                        continue
+                    if _terminal_name(target) in ("jit", "pjit"):
+                        roots.add(node)
+        # Transitive closure over same-module calls (f() or self.f()).
+        compiled = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            body = fn.body if not isinstance(fn, ast.Lambda) else [ast.Expr(fn.body)]
+            for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+                if isinstance(node, ast.Call):
+                    for target in self._resolve_fn_arg(node.func):
+                        if target not in compiled:
+                            compiled.add(target)
+                            frontier.append(target)
+        return compiled
+
+    # -- rules -----------------------------------------------------------
+
+    def check_compiled_region_rules(self) -> None:
+        for fn in self.compiled_regions():
+            body = fn.body if not isinstance(fn, ast.Lambda) else [ast.Expr(fn.body)]
+            fn_name = getattr(fn, "name", "<lambda>")
+            for node in _walk_skipping_defs(list(body)):
+                if not isinstance(node, ast.Call):
+                    continue
+                self._check_host_sync(node, fn_name)
+                self._check_wall_clock(node, fn_name)
+
+    def _check_host_sync(self, call: ast.Call, fn_name: str) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not call.args:
+                self.emit(
+                    "host-sync-in-step", call,
+                    f".item() in compiled region {fn_name!r} blocks on the "
+                    "device every step — keep metrics as device arrays and "
+                    "fetch at log points",
+                )
+                return
+            if func.attr == "device_get":
+                self.emit(
+                    "host-sync-in-step", call,
+                    f"jax.device_get in compiled region {fn_name!r} is a "
+                    "host round-trip inside the step",
+                )
+                return
+            if func.attr in ("asarray", "array") and isinstance(func.value, ast.Name):
+                if func.value.id in ("np", "numpy", "onp"):
+                    self.emit(
+                        "host-sync-in-step", call,
+                        f"np.{func.attr} in compiled region {fn_name!r} "
+                        "materializes a traced value on host",
+                    )
+                return
+        if isinstance(func, ast.Name) and func.id in ("float", "int") and len(call.args) == 1:
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant):
+                return
+            # Static-config casts are fine: self/cls attributes, and shape/
+            # dtype metadata (Python values at trace time, no device sync).
+            if _is_self_attribute(arg):
+                return
+            if any(n in ("shape", "ndim", "size", "dtype") for n in _identifiers(arg)):
+                return
+            self.emit(
+                "host-sync-in-step", call,
+                f"{func.id}() on a (possibly traced) value in compiled "
+                f"region {fn_name!r} forces a device sync — use jnp casts "
+                "and fetch on host at sync points",
+            )
+
+    def _check_wall_clock(self, call: ast.Call, fn_name: str) -> None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if (
+            isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+            and func.attr in _WALL_CLOCK_TIME_ATTRS
+        ):
+            self.emit(
+                "wall-clock-in-step", call,
+                f"time.{func.attr}() in compiled region {fn_name!r} freezes "
+                "at trace time and breaks bit-exact resume",
+            )
+        elif func.attr in ("now", "utcnow") and "datetime" in set(
+            _identifiers(func.value)
+        ):
+            self.emit(
+                "wall-clock-in-step", call,
+                f"datetime.{func.attr}() in compiled region {fn_name!r} "
+                "freezes at trace time and breaks bit-exact resume",
+            )
+
+    def check_bare_except(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+                    continue  # catch-log-reraise keeps the interrupt alive
+                self.emit(
+                    "bare-except", node,
+                    "bare 'except:' swallows KeyboardInterrupt/SystemExit — "
+                    "catch Exception (or the specific error) instead",
+                )
+
+    def check_file_writes(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            called = _terminal_name(node.func)
+            if called not in ("open", "fdopen"):
+                continue
+            mode = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if not isinstance(mode, str) or not set(mode) & set("wax+"):
+                continue
+            if self._rank_gated(node):
+                continue
+            self.emit(
+                "file-write-without-rank-gate", node,
+                f"open(..., {mode!r}) with no process_index == 0 gate in the "
+                "enclosing function or class — in a multi-host job every "
+                "process runs this write (utils/logger convention: rank 0 "
+                "owns the file)",
+            )
+
+    def _rank_gated(self, node: ast.AST) -> bool:
+        cur: ast.AST | None = node
+        enclosing_fn = None
+        enclosing_cls = None
+        while cur is not None:
+            if isinstance(cur, ast.If) and _is_rank_gate(cur.test):
+                return True
+            if enclosing_fn is None and isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                enclosing_fn = cur
+            if enclosing_cls is None and isinstance(cur, ast.ClassDef):
+                enclosing_cls = cur
+            cur = self.parents.get(cur)
+        # Lenient fallbacks: a guard-with-early-return anywhere in the same
+        # function, or a class whose construction establishes the gate
+        # (EventLog: self.enabled = ... and proc == 0).
+        if enclosing_fn is not None:
+            for sub in ast.walk(enclosing_fn):
+                if isinstance(sub, ast.If) and _is_rank_gate(sub.test):
+                    return True
+        if enclosing_cls is not None:
+            for sub in ast.walk(enclosing_cls):
+                if isinstance(sub, ast.Assign) and any(
+                    _is_self_attribute(t) and t.attr == "enabled"
+                    for t in sub.targets
+                ):
+                    if _is_rank_gate(sub.value):
+                        return True
+        return False
+
+    def check_cross_thread(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            targets: list[str] = []
+            for node in ast.walk(cls):
+                if (
+                    isinstance(node, ast.Call)
+                    and _terminal_name(node.func) == "Thread"
+                ):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            if _is_self_attribute(kw.value):
+                                targets.append(kw.value.attr)
+                            elif isinstance(kw.value, ast.Name):
+                                targets.append(kw.value.id)
+            if not targets:
+                continue
+            # Thread region = target methods + same-class methods they call.
+            region: set[str] = set()
+            frontier = [t for t in targets if t in methods]
+            while frontier:
+                name = frontier.pop()
+                if name in region:
+                    continue
+                region.add(name)
+                for node in ast.walk(methods[name]):
+                    if isinstance(node, ast.Call) and _is_self_attribute(node.func):
+                        if node.func.attr in methods and node.func.attr not in region:
+                            frontier.append(node.func.attr)
+            for name in sorted(region):
+                self._check_thread_method(cls, methods[name], name)
+
+    def _check_thread_method(
+        self, cls: ast.ClassDef, method: ast.AST, name: str
+    ) -> None:
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign):
+                stores = [t for t in node.targets if _is_self_attribute(t)]
+            elif isinstance(node, ast.AugAssign) and _is_self_attribute(node.target):
+                stores = [node.target]
+            else:
+                continue
+            if not stores:
+                continue
+            if self._under_self_lock(node, boundary=method):
+                continue
+            for target in stores:
+                self.emit(
+                    "cross-thread-mutation-without-lock", node,
+                    f"self.{target.attr} is mutated in {cls.name}.{name} — "
+                    "code reachable from a threading.Thread target — outside "
+                    "any 'with self.<lock>:' block; the main thread shares "
+                    "this object",
+                )
+
+    def _under_self_lock(self, node: ast.AST, boundary: ast.AST) -> bool:
+        cur: ast.AST | None = node
+        while cur is not None and cur is not boundary:
+            if isinstance(cur, ast.With) and any(
+                _is_self_attribute(item.context_expr)
+                or (
+                    isinstance(item.context_expr, ast.Call)
+                    and _is_self_attribute(item.context_expr.func)
+                )
+                for item in cur.items
+            ):
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def check_missing_donate(self) -> None:
+        seen_defs: set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and _terminal_name(node.func) in (
+                "jit", "pjit",
+            ):
+                if any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in node.keywords
+                ):
+                    continue
+                if not node.args:
+                    continue
+                # A bare name may resolve to several same-named defs (a
+                # nested fn shadowing a method): one finding per call site.
+                for fn in self._resolve_fn_arg(node.args[0]):
+                    if self._state_first_param(fn):
+                        seen_defs.add(fn)
+                        self.emit(
+                            "missing-donate-on-jit", node,
+                            f"jax.jit({getattr(fn, 'name', '<lambda>')}) "
+                            "carries state (first parameter "
+                            f"{self._first_param(fn)!r}) but no "
+                            "donate_argnums — the old state buffers stay "
+                            "live across the update, doubling state memory",
+                        )
+                        break
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    target = deco.func if isinstance(deco, ast.Call) else deco
+                    kws = deco.keywords if isinstance(deco, ast.Call) else []
+                    is_jit = _terminal_name(target) in ("jit", "pjit") or (
+                        isinstance(deco, ast.Call)
+                        and _terminal_name(target) == "partial"
+                        and deco.args
+                        and _terminal_name(deco.args[0]) in ("jit", "pjit")
+                    )
+                    if not is_jit or node in seen_defs:
+                        continue
+                    if any(
+                        kw.arg in ("donate_argnums", "donate_argnames")
+                        for kw in kws
+                    ):
+                        continue
+                    if self._state_first_param(node):
+                        self.emit(
+                            "missing-donate-on-jit", node,
+                            f"@jit on {node.name!r} carries state (first "
+                            f"parameter {self._first_param(node)!r}) but no "
+                            "donate_argnums",
+                        )
+
+    @staticmethod
+    def _first_param(fn: ast.AST) -> str | None:
+        args = fn.args.args
+        if args and args[0].arg in ("self", "cls"):
+            args = args[1:]
+        return args[0].arg if args else None
+
+    def _state_first_param(self, fn: ast.AST) -> bool:
+        first = self._first_param(fn)
+        return first is not None and (
+            first in _STATE_PARAM_NAMES or first.endswith("_state")
+        )
+
+    def run(self) -> list[Finding]:
+        self.check_compiled_region_rules()
+        self.check_bare_except()
+        self.check_file_writes()
+        self.check_cross_thread()
+        self.check_missing_donate()
+        self.findings.sort(key=lambda f: (f.line, f.rule))
+        return self.findings
+
+
+# -- public API -------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>") -> LintResult:
+    """Lint one module's source. Syntax errors surface as the generic
+    layer's concern (``analysis.generic``) — here they raise."""
+    tree = ast.parse(source, filename=path)
+    findings = _ModuleLint(tree, source, path).run()
+    waivers = scan_waivers(source, path)
+    resolved: list[Finding] = []
+    for finding in findings:
+        waiver = waivers.get(finding.line)
+        if waiver is not None and waiver.covers(finding.rule):
+            waiver.used = True
+            if waiver.reason:
+                finding.waived = True
+                finding.waiver_reason = waiver.reason
+            else:
+                resolved.append(
+                    Finding(
+                        rule="waiver-missing-reason",
+                        path=path,
+                        line=waiver.line,
+                        message=(
+                            f"disable={','.join(waiver.rules)} has no "
+                            "'-- <reason>': waivers must say why "
+                            "(the finding below stays live)"
+                        ),
+                    )
+                )
+        resolved.append(finding)
+    return LintResult(resolved, list(waivers.values()))
+
+
+def lint_paths(paths: Iterable[str]) -> LintResult:
+    """Lint every ``.py`` file under the given files/directories."""
+    result = LintResult([], [])
+    for root in paths:
+        files = []
+        if os.path.isdir(root):
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames
+                    if f.endswith(".py")
+                )
+        else:
+            files.append(root)
+        for file in sorted(files):
+            with open(file, encoding="utf-8") as f:
+                source = f.read()
+            result = result.merge(lint_source(source, file))
+    return result

@@ -1,0 +1,60 @@
+"""Inline lint waivers: ``# jaxlint: disable=<rule>[,<rule>] -- <reason>``.
+
+A waiver is an *audited exception*, not an escape hatch: the reason after
+``--`` is mandatory (a disable comment without one does not waive anything —
+it surfaces as its own ``waiver-missing-reason`` finding), the waiver only
+applies to the physical line the finding anchors on (for a multi-line call,
+that is the line the call opens on), and ``scripts/static_audit.py`` counts
+and prints every waiver in effect so reviewers see the full exception list
+on every run, not just the diff that introduced one.
+
+Why same-line only: a file- or block-scoped disable silently covers code
+added later — exactly the "reviewer-remembered invariant" failure mode this
+subsystem exists to remove. One waiver, one line, one reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+__all__ = ["Waiver", "scan_waivers", "WAIVER_RE"]
+
+WAIVER_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([\w\-]+(?:\s*,\s*[\w\-]+)*)(?:\s*--\s*(.*\S))?"
+)
+
+
+@dataclasses.dataclass
+class Waiver:
+    """One inline waiver comment."""
+
+    path: str
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    used: bool = False  # set when a finding actually matched it
+
+    def covers(self, rule: str) -> bool:
+        return rule in self.rules or "all" in self.rules
+
+
+def scan_waivers(source: str, path: str = "<string>") -> dict[int, Waiver]:
+    """Map line number -> :class:`Waiver` for every disable comment.
+
+    Scans raw source lines rather than the AST so a waiver inside a
+    multi-line expression is still found on its own physical line. A
+    ``jaxlint: disable`` inside a string literal would false-positive here;
+    that costs a phantom *unused* waiver in the report, never a silently
+    suppressed finding.
+    """
+    waivers: dict[int, Waiver] = {}
+    for lineno, text in enumerate(source.splitlines(), 1):
+        m = WAIVER_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        waivers[lineno] = Waiver(
+            path=path, line=lineno, rules=rules, reason=m.group(2)
+        )
+    return waivers

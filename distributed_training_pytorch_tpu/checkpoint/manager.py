@@ -416,7 +416,7 @@ class CheckpointManager:
                     "size": os.path.getsize(fp),
                     "sha256": digest.hexdigest(),
                 }
-        with open(os.path.join(staging, MANIFEST_NAME), "w") as f:
+        with open(os.path.join(staging, MANIFEST_NAME), "w") as f:  # jaxlint: disable=file-write-without-rank-gate -- both call sites are process_index()==0-gated (save path and ctor crash recovery); the gate is one frame up, outside this helper's lexical scope
             json.dump({"version": 1, "files": entries}, f)
             f.flush()
             os.fsync(f.fileno())

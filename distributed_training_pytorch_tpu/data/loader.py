@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import queue
-import threading
 from typing import Callable, Iterator, Optional
 
 import jax

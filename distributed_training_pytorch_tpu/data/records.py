@@ -78,7 +78,7 @@ class RecordFileWriter:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "wb")
+        self._f = open(path, "wb")  # jaxlint: disable=file-write-without-rank-gate -- dataset-authoring writer: runs offline (shard prep), single-process by contract, never inside a multi-host training job
         self._f.write(MAGIC)
         self._f.write(struct.pack("<Q", 0))  # count, patched on close
         self._offsets: list[int] = []

@@ -177,10 +177,10 @@ def corrupt_checkpoint(path: str, *, mode: str = "truncate") -> str:
     if victim is None:
         raise FileNotFoundError(f"no files to corrupt under {path}")
     if mode == "truncate":
-        with open(victim, "rb+") as f:
+        with open(victim, "rb+") as f:  # jaxlint: disable=file-write-without-rank-gate -- fault-injection harness: deliberately corrupts checkpoint bytes in single-process tests
             f.truncate(max(0, size // 2))
     elif mode == "flip":
-        with open(victim, "rb+") as f:
+        with open(victim, "rb+") as f:  # jaxlint: disable=file-write-without-rank-gate -- fault-injection harness: deliberately corrupts checkpoint bytes in single-process tests
             f.seek(size // 2)
             b = f.read(1)
             f.seek(size // 2)

@@ -71,6 +71,13 @@ class StepWatchdog:
         self._last_pat = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # pat()/elapsed run on the training thread, _run on the watchdog
+        # thread; both touch _last_pat/_pats/fired. CPython makes the
+        # individual stores atomic, but "fired bumped, window not yet
+        # re-armed" interleavings are real — one lock, held only around the
+        # field accesses (never across on_timeout), removes the class of bug
+        # (jaxlint: cross-thread-mutation-without-lock).
+        self._lock = threading.Lock()
 
     def start(self) -> "StepWatchdog":
         if self._thread is not None:
@@ -85,8 +92,9 @@ class StepWatchdog:
 
     def pat(self) -> None:
         """Mark progress (call once per completed step)."""
-        self._pats += 1
-        self._last_pat = time.monotonic()
+        with self._lock:
+            self._pats += 1
+            self._last_pat = time.monotonic()
 
     def stop(self) -> None:
         self._stop.set()
@@ -96,25 +104,33 @@ class StepWatchdog:
 
     @property
     def elapsed(self) -> float:
-        return time.monotonic() - self._last_pat
+        with self._lock:
+            return time.monotonic() - self._last_pat
 
     def _run(self) -> None:
         window = self.timeout
         pats_at_fire = -1
         while not self._stop.wait(self.poll_interval):
-            if self.fired >= self.max_fires:
-                return
-            if pats_at_fire >= 0 and self._pats > pats_at_fire:
-                window = self.timeout  # a REAL pat since the fire: de-escalate
-                pats_at_fire = -1
-            if self.elapsed > window:
-                self.fired += 1
-                pats_at_fire = self._pats
+            fire = False
+            with self._lock:
+                if self.fired >= self.max_fires:
+                    return
+                if pats_at_fire >= 0 and self._pats > pats_at_fire:
+                    window = self.timeout  # a REAL pat since the fire: de-escalate
+                    pats_at_fire = -1
+                if time.monotonic() - self._last_pat > window:
+                    fire = True
+                    self.fired += 1
+                    pats_at_fire = self._pats
+            if fire:
                 try:
+                    # Outside the lock: on_timeout may run arbitrary trainer
+                    # code (save, log) that must not deadlock against pat().
                     self.on_timeout()
                 except Exception:
                     pass  # the watchdog must never take the process down itself
-                self._last_pat = time.monotonic()  # re-arm window for next fire
+                with self._lock:
+                    self._last_pat = time.monotonic()  # re-arm for next fire
                 window = self.timeout * self.escalation_factor
 
     def __enter__(self) -> "StepWatchdog":

@@ -22,11 +22,10 @@ the ambient mesh (pass ``mesh=``).
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 
 from distributed_training_pytorch_tpu.parallel.moe import MoEMlp

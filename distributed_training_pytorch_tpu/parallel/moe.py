@@ -39,7 +39,7 @@ mean dispatch fraction per expert, scaled by E) and ``router_z_loss``.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -50,7 +50,6 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 # Partial-manual shard_map (`axis_names`): the compat shim maps it onto the

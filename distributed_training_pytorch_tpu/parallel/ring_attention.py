@@ -23,7 +23,6 @@ the 8-device CPU mesh).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -177,7 +177,7 @@ def update_baseline(
     if tolerance is not None:
         baseline.setdefault("tolerance", {})[key] = float(tolerance)
     tmp = path + ".staging"
-    with open(tmp, "w", encoding="utf-8") as f:
+    with open(tmp, "w", encoding="utf-8") as f:  # jaxlint: disable=file-write-without-rank-gate -- the --update baseline ritual: an operator CLI writing a repo file on one machine, not a training-job artifact
         json.dump(baseline, f, indent=2, sort_keys=True)
         f.write("\n")
     os.replace(tmp, path)

@@ -354,9 +354,15 @@ class AsyncCheckpointSaver:
                 self._manager.save(req.name, req.state, req.epoch, **req.kwargs)
                 self._manager.wait()  # sync manager: already committed; no-op
                 req.commit_s = time.perf_counter() - t0
-                req.status = COMMITTED
-                self.committed += 1
-                self.last_commit_s = req.commit_s
+                # State-reporting counters are read from the training thread
+                # (measure_save_stall, tests, the chaos soak) — publish them
+                # under the same lock every other shared field uses, so a
+                # reader never sees committed bumped with last_commit_s
+                # still stale (jaxlint: cross-thread-mutation-without-lock).
+                with self._cond:
+                    req.status = COMMITTED
+                    self.committed += 1
+                    self.last_commit_s = req.commit_s
                 if self._on_commit is not None:
                     try:
                         self._on_commit(req.name, req.commit_s)

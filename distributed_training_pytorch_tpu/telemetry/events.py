@@ -7,10 +7,13 @@ event log records the run's *discrete* happenings — run start/end,
 compilation, checkpoint save/restore, preemption, fault injection,
 loss-scale backoff, anomaly, profiling captures (``profile_capture``: trace
 path, traced window, category fractions + dispatch-gap audit, emitted by
-``profiling.StepTraceCapture``), and perf-gate verdicts (``perf_gate``:
+``profiling.StepTraceCapture``), perf-gate verdicts (``perf_gate``:
 measured vs baseline, tolerance, verdict, emitted by
-``scripts/perf_gate.py``) — as one JSON object per line, machine-readable
-and append-only.
+``scripts/perf_gate.py``), and static-audit verdicts (``static_audit``:
+per-rule lint counts, waiver counts, undonated param/opt-state bytes of
+the single-step and chained programs, precision leaks, host callbacks,
+emitted by ``scripts/static_audit.py --events``) — as one JSON object per
+line, machine-readable and append-only.
 
 Conventions:
 

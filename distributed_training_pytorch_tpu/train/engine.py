@@ -212,7 +212,7 @@ class TrainEngine:
             out_shardings=(state_sharding, self._replicated),
             donate_argnums=self._donate,
         )
-        self._eval_step = jax.jit(
+        self._eval_step = jax.jit(  # jaxlint: disable=missing-donate-on-jit -- eval only READS state: donating would consume the very buffers the next train step needs
             eval_step,
             in_shardings=(state_sharding, self._batch_sharding),
             out_shardings=self._replicated,
@@ -458,9 +458,21 @@ class TrainEngine:
         if length < 1:
             raise ValueError(f"length must be >= 1, got {length}")
         self._build_steps(state)
+        fn = self._chained_step_fn(length, state)
+        with self._ambient_mesh():
+            return fn(state, stacked_batch)
+
+    def _chained_step_fn(self, length: int, state_or_abstract):
+        """The jitted chained-window program for ``length`` (built and cached
+        on first use). Split out of :meth:`train_steps_chained` so the REAL
+        dispatch program can be *lowered* on abstract avals without executing
+        a window — which is how ``tests/test_analysis.py`` pins the static
+        audit's chained probe (:meth:`lower_step_probe`; no trace-count side
+        effects) byte-equal to this program: the audit verifies what the
+        trainer actually runs, enforced rather than claimed."""
         fn = self._chained_fns.get(length)
         if fn is None:
-            state_sharding = self.state_sharding(state)
+            state_sharding = self.state_sharding(state_or_abstract)
             chain_sharding = mesh_lib.chain_batch_sharding(self.mesh)
 
             def chained(st, sbatch):
@@ -486,8 +498,7 @@ class TrainEngine:
                 donate_argnums=self._donate,
             )
             self._chained_fns[length] = fn
-        with self._ambient_mesh():
-            return fn(state, stacked_batch)
+        return fn
 
     def unstack_window(self, stacked_batch, index: int):
         """Slice step ``index``'s batch out of a chain-stacked window, laid
@@ -515,33 +526,84 @@ class TrainEngine:
             return lowered.compile(compiler_options=dict(compiler_options))
         return lowered.compile()
 
-    def compile_step_probe(self, state, batch):
-        """Observability-only compiled copy of ``_train_step_impl`` (no
-        counting wrapper, no donation) on abstract avals: one extra
-        off-hot-path XLA compile, but the dispatch executables, their jit
-        caches, and ``trace_counts`` are untouched — the retrace-guard
-        contract holds with telemetry/profiling on (test-enforced).
-        ``state``/``batch`` may be concrete arrays or ``ShapeDtypeStruct``
-        trees (no data is read). Feeds :meth:`step_cost_analysis` (the MFU
-        probe) and the profile capture's per-op roofline join — memoized per
-        abstract shape, so a run with both telemetry and profiling on pays
-        the probe compile once, not once per consumer."""
+    def lower_step_probe(self, state, batch, *, donate: bool = False,
+                         chain_length: int | None = None):
+        """Lower (but do not compile) the observability probe — the
+        pre-optimization module text (``.as_text()``) is what the static
+        audit's precision-leak check reads: program *semantics* (a bf16
+        policy's bf16 dots), where the compiled text on CPU shows the
+        backend's f32-promotion of those same dots. See
+        :meth:`compile_step_probe` for the donate/chain_length contract."""
+        abstract_state, abstract_batch = jax.eval_shape(
+            lambda s, b: (s, b), state, batch
+        )
+        state_sharding = self.state_sharding(state)
+        if chain_length is None:
+            fn = self._train_step_impl
+            batch_sharding = self._batch_sharding
+        else:
+            if chain_length < 1:
+                raise ValueError(f"chain_length must be >= 1, got {chain_length}")
+            length = int(chain_length)
+
+            def chained(st, sbatch):
+                # The real chained window program (_chained_step_fn) minus
+                # its trace-counting wrapper: same name, same scan, same
+                # unroll, same shardings — lowered-HLO equality with the
+                # dispatch program is pinned by test_analysis.py, so the two
+                # constructions cannot drift apart silently.
+                return jax.lax.scan(self._train_step_impl, st, sbatch, unroll=length)
+
+            fn = chained
+            batch_sharding = mesh_lib.chain_batch_sharding(self.mesh)
+        probe = jax.jit(
+            fn,
+            in_shardings=(state_sharding, batch_sharding),
+            out_shardings=(state_sharding, self._replicated),
+            # Mirror the dispatch path's donation EXACTLY: an engine built
+            # with donate_state=False runs undonated programs, and the
+            # donation audit must see (and fail on) that program, not a
+            # donated twin that never dispatches.
+            donate_argnums=self._donate if donate else (),
+        )
+        with self._ambient_mesh():
+            return probe.lower(abstract_state, abstract_batch)
+
+    def compile_step_probe(self, state, batch, *, donate: bool = False,
+                           chain_length: int | None = None):
+        """Observability-only compiled copy of the train program (no
+        counting wrapper) on abstract avals: one extra off-hot-path XLA
+        compile, but the dispatch executables, their jit caches, and
+        ``trace_counts`` are untouched — the retrace-guard contract holds
+        with telemetry/profiling on (test-enforced). ``state``/``batch`` may
+        be concrete arrays or ``ShapeDtypeStruct`` trees (no data is read).
+
+        ``donate=False, chain_length=None`` (default) is the historical
+        probe: the single step, undonated — feeds :meth:`step_cost_analysis`
+        (the MFU probe) and the profile capture's per-op roofline join.
+        ``donate=True`` mirrors the dispatch path's ``donate_argnums`` so the
+        static audit (``analysis.hlo_audit``) can verify input-output buffer
+        aliasing on the program the trainer actually runs; ``chain_length=N``
+        probes the chained-window program (``batch`` then carries the leading
+        step axis). Memoized per (abstract shape, donate, chain_length), so a
+        run with both telemetry and profiling on pays each probe compile
+        once, not once per consumer."""
         abstract_state, abstract_batch = jax.eval_shape(
             lambda s, b: (s, b), state, batch
         )
         leaves, treedef = jax.tree.flatten((abstract_state, abstract_batch))
-        key = (treedef, tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves))
+        key = (
+            treedef,
+            tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves),
+            bool(donate),
+            chain_length,
+        )
         cached = self._step_probe_cache.get(key)
         if cached is not None:
             return cached
-        state_sharding = self.state_sharding(state)
-        probe = jax.jit(
-            self._train_step_impl,
-            in_shardings=(state_sharding, self._batch_sharding),
-            out_shardings=(state_sharding, self._replicated),
-        )
-        with self._ambient_mesh():
-            compiled = probe.lower(abstract_state, abstract_batch).compile()
+        compiled = self.lower_step_probe(
+            state, batch, donate=donate, chain_length=chain_length
+        ).compile()
         self._step_probe_cache[key] = compiled
         return compiled
 

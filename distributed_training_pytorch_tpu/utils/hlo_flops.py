@@ -28,6 +28,7 @@ __all__ = [
     "xla_cost_analysis",
     "bytes_accessed",
     "arithmetic_intensity",
+    "aval_bytes",
 ]
 
 
@@ -83,6 +84,40 @@ DTYPE_BYTES = {
     "s64": 8, "u64": 8, "f64": 8, "c64": 8,
     "c128": 16,
 }
+def aval_bytes(shape, dtype) -> float:
+    """Byte size of one array leaf at the HLO dtype widths above — the
+    sizing the static audit's donation report uses (``analysis.hlo_audit``:
+    undonated bytes of param/optimizer-state inputs), so a lint report and a
+    per-op roofline row account memory with the same table. ``dtype``
+    accepts numpy/jax dtypes or names; anything unmappable (extended dtypes
+    like typed PRNG keys) falls back to 4 bytes/element — an estimate, same
+    contract as the per-op ``bytes`` rows."""
+    import numpy as np
+
+    n = 1
+    for dim in shape:
+        n *= int(dim)
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        return float(n * 4)
+    if name == "bool":
+        hlo = "pred"
+    elif name.startswith("float"):
+        hlo = "f" + name[len("float"):]
+    elif name.startswith("bfloat"):
+        hlo = "bf" + name[len("bfloat"):]
+    elif name.startswith("uint"):
+        hlo = "u" + name[len("uint"):]
+    elif name.startswith("int"):
+        hlo = "s" + name[len("int"):]
+    elif name.startswith("complex"):
+        hlo = "c" + name[len("complex"):]
+    else:
+        hlo = name
+    return float(n * DTYPE_BYTES.get(hlo, 4))
+
+
 CONV_RE = re.compile(r" convolution\((.*?)\), window={(.*?)}, dim_labels=(\S+?)[,\s]")
 DOT_RE = re.compile(r" dot\((.*?)\),.*?lhs_contracting_dims={([0-9,]*)}")
 OPERAND_RE = re.compile(r"%([\w.\-]+)")

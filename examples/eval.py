@@ -25,7 +25,6 @@ sys.path.insert(0, ".")
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from distributed_training_pytorch_tpu.checkpoint import CheckpointManager
 from distributed_training_pytorch_tpu.data import (

@@ -40,7 +40,6 @@ import sys
 sys.path.insert(0, ".")
 
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from distributed_training_pytorch_tpu.data import (

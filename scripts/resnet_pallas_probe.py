@@ -30,7 +30,6 @@ sys.path.insert(0, ".")
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act
 

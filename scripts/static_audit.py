@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Static audit gate — ruff/generic + jaxlint + compiled-program HLO audit.
+
+Six PRs of reliability work fixed the same bug classes after the fact:
+cross-thread mutation without a lock (PR 5's EventLog t_mono fix), host
+syncs sneaking into the hot path, rank-0 file-ownership violations,
+undonated device buffers (ROADMAP item 3). This gate makes those invariants
+machine-checked (ISSUE 7; rule catalog and history in
+docs/static_analysis.md). Three passes, strictest-first cheap-first:
+
+1. **generic** (``analysis.generic``): ruff with the repo's
+   ``[tool.ruff]`` config when installed; a stdlib fallback (syntax +
+   unused-import) in hermetic environments. jaxlint deliberately carries
+   NO generic rules — this layer owns them.
+2. **jaxlint** (``analysis.lint``): the six project rules over the package
+   source. Findings are fatal unless waived inline
+   (``# jaxlint: disable=<rule> -- <reason>``); every waiver in effect is
+   printed so the exception list is reviewed on every run.
+3. **HLO audit** (``analysis.hlo_audit``): lowers the REAL single-step and
+   chained train programs on abstract avals (CPU-viable, nothing executes)
+   and verifies 100% of param/optimizer-state input bytes are donated, a
+   bf16 program leaks no fp32 dot/conv, and the chained program contains
+   no host callbacks.
+
+Self-test seam (the perf gate's ``--inject-slowdown`` analog):
+``--inject-violation lint`` lints a synthetic module with one violation of
+every rule merged into the real run; ``--inject-violation hlo`` audits the
+probes lowered WITHOUT donation. Both must make this gate FAIL —
+verify.sh asserts it, so the gate's teeth are themselves tested on every
+run.
+
+``--events PATH`` appends a ``static_audit`` record to a telemetry JSONL
+log (rule counts, waiver counts, undonated bytes) so audit results are
+greppable next to ``perf_gate`` records.
+
+Exit codes: 0 clean, 1 generic findings, 2 jaxlint findings, 3 HLO audit
+violations (first failing pass wins).
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# Paths are anchored to the repo root (NOT the cwd): run from anywhere, the
+# gate scans the same tree — a cwd-relative scan that finds zero files would
+# print PASS having checked nothing.
+PACKAGE = os.path.join(REPO_ROOT, "distributed_training_pytorch_tpu")
+# The generic layer covers everything Python; jaxlint covers the package
+# (scripts/examples are single-process host-side drivers — the multi-host
+# and compiled-region rules do not apply to them by construction).
+GENERIC_PATHS = [PACKAGE] + [
+    os.path.join(REPO_ROOT, p)
+    for p in ("scripts", "tests", "examples", "bench.py", "__graft_entry__.py")
+]
+LINT_PATHS = [PACKAGE]
+
+# One violation of every jaxlint rule, in ~20 lines — the lint self-test
+# fixture. If a rule rewrite stops catching its class of bug, the injection
+# run passes and verify.sh fails the build.
+INJECTED_LINT_SNIPPET = '''\
+import threading
+import time
+import numpy as np
+import jax
+
+
+def train_step(state, batch):
+    loss = state["params"].sum() + batch.sum()
+    host = float(loss)                      # host-sync-in-step
+    t = time.time()                         # wall-clock-in-step
+    _ = np.asarray(loss)                    # host-sync-in-step
+    return state, {"loss": host, "t": t}
+
+
+stepped = jax.jit(train_step)               # missing-donate-on-jit
+
+
+def write_log(line):
+    with open("audit.log", "a") as f:       # file-write-without-rank-gate
+        f.write(line)
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        try:
+            self.count += 1                 # cross-thread-mutation-without-lock
+        except:                             # bare-except
+            pass
+'''
+
+
+def run_generic_pass() -> tuple[int, dict]:
+    # Submodule import: the generic/lint passes never need hlo_audit's
+    # XLA machinery loaded (the package __init__ would pull it in).
+    from distributed_training_pytorch_tpu.analysis.generic import run_generic
+
+    paths = [p for p in GENERIC_PATHS if os.path.exists(p)]
+    if not paths:
+        print(f"static_audit: [1/3] generic: NO scan paths exist under "
+              f"{REPO_ROOT} — refusing a vacuous pass")
+        return 1, {"generic_tool": "none", "generic_findings": 1}
+    report = run_generic(paths)
+    print(f"static_audit: [1/3] generic ({report.tool}): "
+          f"{len(report.findings)} finding(s)")
+    for finding in report.findings:
+        print("  " + finding.describe())
+    return len(report.findings), {"generic_tool": report.tool,
+                                  "generic_findings": len(report.findings)}
+
+
+def run_lint_pass(inject: bool) -> tuple[int, dict]:
+    from distributed_training_pytorch_tpu.analysis.lint import (
+        lint_paths,
+        lint_source,
+    )
+
+    paths = [p for p in LINT_PATHS if os.path.exists(p)]
+    if not paths:
+        print("static_audit: [2/3] jaxlint: NO scan paths exist — refusing "
+              "a vacuous pass")
+        return 1, {"lint_findings": 1, "lint_waived": 0, "lint_rule_counts": {}}
+    result = lint_paths(paths)
+    if inject:
+        result = result.merge(
+            lint_source(INJECTED_LINT_SNIPPET, "<injected-violation>")
+        )
+        print("static_audit: SELF-TEST — injected a synthetic module "
+              "violating every jaxlint rule (this gate must fail)")
+    unwaived = result.unwaived
+    counts = result.counts()
+    print(f"static_audit: [2/3] jaxlint: {len(unwaived)} unwaived finding(s), "
+          f"{len(result.waived)} waived, rule counts: "
+          + (str(counts) if counts else "{}"))
+    for finding in unwaived:
+        print("  " + finding.describe())
+    for finding in result.waived:
+        print("  " + finding.describe())
+    for waiver in result.unused_waivers:
+        print(f"  NOTE unused waiver at {waiver.path}:{waiver.line} "
+              f"(rules {','.join(waiver.rules)}) — the finding it covered "
+              "is gone; delete the comment")
+    fields = {
+        "lint_findings": len(unwaived),
+        "lint_waived": len(result.waived),
+        "lint_rule_counts": counts,
+    }
+    return len(unwaived), fields
+
+
+def run_hlo_pass(inject: bool, chain_steps: int) -> tuple[int, dict]:
+    from distributed_training_pytorch_tpu.analysis.hlo_audit import run_hlo_audit
+
+    if inject:
+        print("static_audit: SELF-TEST — auditing probes lowered WITHOUT "
+              "donation (this gate must fail)")
+    report = run_hlo_audit(chain_steps=chain_steps, inject_violation=inject)
+    print(f"static_audit: [3/3] HLO audit (chain_steps={chain_steps}):")
+    print(report.describe())
+    return (0 if report.ok else 1), report.to_fields()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--inject-violation", choices=("lint", "hlo"), default=None,
+        help="self-test seam: make the named pass audit a known-bad input; "
+             "the gate must exit non-zero (verify.sh asserts it)")
+    parser.add_argument(
+        "--chain-steps", type=int, default=4,
+        help="window length of the chained program the HLO audit lowers")
+    parser.add_argument(
+        "--skip-hlo", action="store_true",
+        help="source passes only — skips the XLA lowerings/compiles (jax "
+             "itself still imports via the package): the fast path for "
+             "editor/pre-commit hooks; verify.sh always runs the full gate")
+    parser.add_argument(
+        "--events", default=None,
+        help="append a static_audit record to this JSONL event log")
+    args = parser.parse_args()
+    if args.skip_hlo and args.inject_violation == "hlo":
+        # The perf_gate flag-conflict discipline: refuse BEFORE doing any
+        # work — skipping the very pass the injection targets would print
+        # PASS having verified nothing.
+        parser.error("--inject-violation hlo requires the HLO pass; "
+                     "drop --skip-hlo")
+
+    fields: dict = {"injected": args.inject_violation}
+    generic_count, f = run_generic_pass()
+    fields.update(f)
+    lint_count, f = run_lint_pass(inject=args.inject_violation == "lint")
+    fields.update(f)
+    hlo_bad = 0
+    if not args.skip_hlo:
+        try:
+            hlo_bad, f = run_hlo_pass(
+                inject=args.inject_violation == "hlo",
+                chain_steps=args.chain_steps,
+            )
+            fields.update(f)
+        except Exception as e:  # audit infrastructure failure, not a finding
+            print(f"static_audit: [3/3] HLO audit ERROR — {type(e).__name__}: "
+                  f"{e}\n  (audit infrastructure failure: the lowering or the "
+                  "leaf->parameter mapping broke, not a lintable finding)")
+            hlo_bad = 1
+            fields["hlo_error"] = f"{type(e).__name__}: {e}"
+
+    if generic_count:
+        rc = 1
+    elif lint_count:
+        rc = 2
+    elif hlo_bad:
+        rc = 3
+    else:
+        rc = 0
+    fields["passed"] = rc == 0
+    fields["injected"] = args.inject_violation  # which pass, not a bool
+    verdict = "PASS" if rc == 0 else f"FAIL (exit {rc})"
+    print(f"static_audit: {verdict}")
+
+    if args.events:
+        from distributed_training_pytorch_tpu.telemetry import EventLog
+
+        EventLog(args.events, process_index=0).emit("static_audit", **fields)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,43 +7,53 @@
 # buried in a multi-minute test run — exactly how the seed's 14 import
 # breakages went unnoticed.
 #
-# Stage 2 is a ~8s CPU run through the real chained Trainer hot path
+# Stage 2 is the static audit (docs/static_analysis.md): generic lint (ruff
+# or the stdlib fallback), jaxlint's six project rules (host syncs in
+# compiled regions, un-rank-gated writes, unlocked cross-thread mutation,
+# wall-clock in jitted code, bare excepts, undonated state jits — every
+# waiver printed with its reason), and the compiled-program HLO audit
+# (100% param/opt-state donation on the real single-step AND chained
+# programs, no fp32 dot/conv under bf16, no host callbacks in the chained
+# window). The gate's teeth are tested on every run: an injected lint
+# violation and an injected undonated lowering must each make it FAIL.
+#
+# Stage 3 is a ~8s CPU run through the real chained Trainer hot path
 # asserting (via the engine's compilation counters) that the chained
 # executable compiles exactly once per shape — a dispatch-path regression
 # that silently retraces every window fails here in seconds instead of as a
 # mysterious multi-minute-per-window slowdown on real hardware.
 #
-# Stage 3 is a ~10s CPU digits run in precision="bf16" asserting the loss
+# Stage 4 is a ~10s CPU digits run in precision="bf16" asserting the loss
 # decreases, no steps are skipped, compute runs in bf16, and master weights
 # stay fp32 — precision regressions fail fast like retrace regressions.
 #
-# Stage 4 is a short CPU digits run with telemetry="on" asserting the event
+# Stage 5 is a short CPU digits run with telemetry="on" asserting the event
 # log is well-formed JSONL, goodput bucket fractions sum to 1 +- eps, and the
 # on-device health stats rode the chained windows without a retrace. The run
 # is also traced with profile=ProfileConfig (ISSUE 6): the capture must
 # complete, its StepProfile category fractions must sum to 1 +- eps, and the
 # profile_capture event must land in the log.
 #
-# Stage 5 is the chaos soak in --quick mode: a real digits training job killed
+# Stage 6 is the chaos soak in --quick mode: a real digits training job killed
 # 3 times (graceful SIGTERM, SIGKILL mid-background-commit, SIGKILL mid-
 # chained-window) at seeded offsets, resumed after each kill, asserting every
 # kill leaves >= 1 valid checkpoint, the final params are bit-exact with an
 # uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
 # save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
 #
-# Stage 6 is the perf-regression gate (docs/profiling.md): a ~10s CPU
+# Stage 7 is the perf-regression gate (docs/profiling.md): a ~10s CPU
 # measurement of the real chained-engine path, gated as a machine-portable
 # calibrated ratio against the committed PERF_BASELINE.json — a step-time
 # regression past tolerance (an accidental retrace, a lost chained dispatch
 # path) fails here. The gate's own teeth are tested on every run: a
 # deliberate 3x injected slowdown must make it FAIL.
 #
-# Stage 7 is the ROADMAP.md tier-1 command verbatim.
+# Stage 8 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/7: import health (pytest --collect-only) =="
+echo "== stage 1/8: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -52,43 +62,61 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/7: chained-dispatch retrace guard =="
-if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
-  echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
+echo "== stage 2/8: static audit (generic + jaxlint + HLO) =="
+if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
+  echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
+  echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md)"
   exit 3
 fi
+if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation lint --skip-hlo \
+    > /tmp/_audit_selftest.log 2>&1; then
+  echo "STATIC AUDIT SELF-TEST FAILED — injected lint violations PASSED the gate"
+  exit 3
+fi
+if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation hlo \
+    > /tmp/_audit_selftest.log 2>&1; then
+  echo "STATIC AUDIT SELF-TEST FAILED — an undonated program PASSED the HLO audit"
+  exit 3
+fi
+echo "static_audit self-tests OK: injected lint + donation violations correctly failed"
 
-echo "== stage 3/7: mixed-precision smoke (bf16 digits) =="
-if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
-  echo "PRECISION SMOKE FAILED — bf16 training path regressed"
+echo "== stage 3/8: chained-dispatch retrace guard =="
+if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
+  echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/7: telemetry smoke (event log + goodput + stats) =="
-if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
-  echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
+echo "== stage 4/8: mixed-precision smoke (bf16 digits) =="
+if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
+  echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/7: chaos soak (kill/resume, async checkpointing) =="
-if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
-  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+echo "== stage 5/8: telemetry smoke (event log + goodput + stats) =="
+if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
+  echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/7: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 6/8: chaos soak (kill/resume, async checkpointing) =="
+if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
+  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+  exit 7
+fi
+
+echo "== stage 7/8: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
-  exit 7
+  exit 8
 fi
 if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; then
   echo "PERF GATE SELF-TEST FAILED — a 3x injected regression PASSED the gate"
-  exit 7
+  exit 8
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 7/7: tier-1 test suite =="
+echo "== stage 8/8: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

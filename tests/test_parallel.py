@@ -14,7 +14,6 @@ from distributed_training_pytorch_tpu.ops import cross_entropy_loss
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel import (
     ring_attention,
-    state_shardings,
     transformer_tp_rules,
     ulysses_attention,
 )

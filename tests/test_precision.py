@@ -16,7 +16,6 @@ trainer, so each must stay cheap.
 
 import os
 import sys
-import tempfile
 
 import jax
 import jax.numpy as jnp

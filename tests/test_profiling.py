@@ -23,7 +23,6 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from distributed_training_pytorch_tpu import profiling

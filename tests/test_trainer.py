@@ -8,7 +8,6 @@ compile/checkpoint time, so tests share the run unless they need their own
 config (resume, periodic-without-validation, preprocess hook).
 """
 
-import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest

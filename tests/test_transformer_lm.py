@@ -9,7 +9,7 @@ import pytest
 
 from distributed_training_pytorch_tpu.models import LMTiny
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
-from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+from distributed_training_pytorch_tpu.train import TrainEngine
 
 
 def tokens_batch(b, t, vocab=256, seed=0):

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distributed_training_pytorch_tpu import memory as memory_lib
 from distributed_training_pytorch_tpu.models import VGG16
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
@@ -157,30 +158,41 @@ def _bench_dtype(dtype_name):
     return BENCH_DTYPES[dtype_name]
 
 
-def _bench_memory(compiled, include_peak=True):
+def _metric_name(cfg, image_size, dtype_name):
+    """The entry's self-describing metric string — ONE implementation for
+    the success line and the OOM-net line, so a sweep's structured OOM
+    record always joins against its sibling entries' metric strings.
+    Metric templates name the historical bf16 dtype; a BENCH_DTYPE override
+    renames them."""
+    return (
+        cfg["metric"].format(size=image_size).replace("bf16", dtype_name or "bf16")
+    )
+
+
+def _bench_memory(compiled, include_peak=True, predicted=None):
     """Per-step device memory: live/peak bytes from the PJRT allocator where
-    the backend exposes them (``memory_stats`` — TPU does, after the timed
-    windows so peak covers the real step), else XLA's ``bytes accessed``
-    estimate from the compiled program (CPU smoke runs).
+    the backend exposes them (``memory.live.live_memory_fields`` — the ONE
+    memory_stats read shared with trainer telemetry and preflight; TPU has
+    it, read after the timed windows so peak covers the real step), else
+    XLA's ``bytes accessed`` estimate from the compiled program (CPU smoke
+    runs). ``predicted_peak_bytes`` (``compiled.memory_analysis()``, the
+    preflight predictor) rides every entry so predicted-vs-measured cannot
+    silently drift across rounds.
 
     ``include_peak=False`` for every sweep run after the first:
-    ``peak_bytes_in_use`` is a process-lifetime high-water mark with no
-    reset, so a later (smaller) dtype's peak would silently report the
-    earlier run's — live_bytes stays valid per-run."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except (AttributeError, NotImplementedError, RuntimeError):
-        stats = None
-    if stats:
-        out = {}
-        if "bytes_in_use" in stats:
-            out["live_bytes"] = int(stats["bytes_in_use"])
-        if "peak_bytes_in_use" in stats and include_peak:
-            out["peak_bytes"] = int(stats["peak_bytes_in_use"])
-        if out:
-            return out
-    ba = hlo_flops.bytes_accessed(compiled)
-    return {"hlo_bytes_accessed": int(ba)} if ba else {}
+    ``peak_bytes`` is a process-lifetime high-water mark with no reset
+    (the ``memory.live`` documented caveat), so a later (smaller) dtype's
+    peak would silently report the earlier run's — live_bytes stays valid
+    per-run."""
+    out = memory_lib.live_memory_fields(include_peak=include_peak)
+    if not out:
+        ba = hlo_flops.bytes_accessed(compiled)
+        out = {"hlo_bytes_accessed": int(ba)} if ba else {}
+    if predicted is None:  # not already captured by the caller's OOM-net ctx
+        predicted = memory_lib.predicted_peak_bytes(compiled)
+    if predicted is not None:
+        out["predicted_peak_bytes"] = predicted
+    return out
 
 
 def _build_vgg16(num_classes, image_size, dtype):
@@ -560,7 +572,11 @@ def _time_windows(run_once, state, steps, windows, reduce, meter=None):
     return state, dt
 
 
-def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
+def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=None):
+    """One full measurement -> one JSON line. ``ctx`` (a dict) is filled with
+    the entry's identity and predicted peak as soon as they are known, so the
+    sweep loop's OOM net (``main``) can emit a structured line for an entry
+    that died mid-measurement."""
     enable_fast_rng()
     # Goodput accounting for the bench run itself (ISSUE 4 satellite,
     # telemetry/goodput.py — the same meter the Trainer carries through
@@ -573,6 +589,9 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     meter.tick("other")  # model build + state init + batch staging
     model_name, cfg = setup["model_name"], setup["cfg"]
     batch, image_size = setup["batch"], setup["image_size"]
+    if ctx is not None:
+        ctx["metric"] = _metric_name(cfg, image_size, dtype_name)
+        ctx["batch"] = batch
     model, engine, state, gbatch = (
         setup["model"], setup["engine"], setup["state"], setup["gbatch"]
     )
@@ -636,6 +655,12 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
             return st, metrics
 
     meter.tick("compile")  # the AOT compile above (XLA, one per run)
+    if ctx is not None:
+        # Known before the first dispatch: an entry that OOMs in the timed
+        # windows still reports the peak the preflight math predicted for it.
+        predicted = memory_lib.predicted_peak_bytes(compiled if chain else probe)
+        if predicted is not None:
+            ctx["predicted_peak_bytes"] = predicted
 
     # Warmup, then best of `windows` timed windows (the shared relay chip's
     # interference only ever subtracts; BENCH_REDUCE=median reports the
@@ -656,7 +681,13 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     # allocator peak covers the real step's live set. Arithmetic intensity
     # uses XLA's own executed flops over its bytes-accessed estimate — the
     # pair the bf16/fp32 sweep moves together (docs/performance.md roofline).
-    memory = _bench_memory(compiled if chain else probe, include_peak=include_peak)
+    memory = _bench_memory(
+        compiled if chain else probe,
+        include_peak=include_peak,
+        # derived exactly once per entry: the OOM-net ctx captured it right
+        # after the AOT compile (same executable, same formula)
+        predicted=ctx.get("predicted_peak_bytes") if ctx is not None else None,
+    )
     arith_intensity = hlo_flops.arithmetic_intensity(compiled if chain else probe)
 
     # Host dispatch gap (ISSUE 2 satellite): per-step wall time when every
@@ -948,11 +979,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True):
     print(
         json.dumps(
             {
-                # metric strings name the historical bf16 dtype; a BENCH_DTYPE
-                # override renames them so sweep lines are self-describing.
-                "metric": cfg["metric"]
-                .format(size=image_size)
-                .replace("bf16", setup["dtype_name"] or "bf16"),
+                "metric": _metric_name(cfg, image_size, setup["dtype_name"]),
                 "value": round(images_per_sec / n_chips, 2),
                 "unit": cfg["unit"],
                 "vs_baseline": round(mfu / 0.60, 4),
@@ -1012,10 +1039,51 @@ def main():
     sweep = [d.strip() for d in os.environ.get("BENCH_DTYPE", "").split(",") if d.strip()]
     for dtype_name in sweep:
         _bench_dtype(dtype_name)
+    failed = False
     for i, dtype_name in enumerate(sweep or [None]):
         # peak_bytes only on the first run of the process: the allocator's
         # peak is a lifetime high-water mark (see _bench_memory).
-        _run_bench(dtype_name, include_peak=(i == 0))
+        #
+        # OOM net (ISSUE 8 satellite): one oversized dtype/model entry must
+        # not abort every entry after it — a RESOURCE_EXHAUSTED entry emits
+        # a structured {"oom": true} line (with the peak the memory
+        # preflight predicted for it, captured before the first dispatch)
+        # and the sweep moves on. Any other failure still aborts: a crash
+        # that is not an OOM is a bug, not a fit boundary.
+        ctx = {}
+        try:
+            _run_bench(dtype_name, include_peak=(i == 0), ctx=ctx)
+        except Exception as e:  # noqa: BLE001 — classified below, re-raised if not OOM
+            if not memory_lib.is_oom_error(e):
+                raise
+            failed = True
+            print(
+                json.dumps(
+                    {
+                        "metric": ctx.get(
+                            "metric", os.environ.get("BENCH_MODEL", "vgg16")
+                        ),
+                        "dtype": dtype_name or "bf16",
+                        "oom": True,
+                        **(
+                            {"batch": ctx["batch"]} if "batch" in ctx else {}
+                        ),
+                        **(
+                            {"predicted_peak_bytes": ctx["predicted_peak_bytes"]}
+                            if "predicted_peak_bytes" in ctx
+                            else {}
+                        ),
+                        "error": (str(e).splitlines() or [type(e).__name__])[0][:300],
+                    }
+                )
+            )
+            print(
+                f"bench: {dtype_name or 'bf16'} entry OOMed — structured line "
+                "emitted, continuing the sweep",
+                file=sys.stderr,
+            )
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

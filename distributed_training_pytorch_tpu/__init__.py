@@ -34,6 +34,11 @@ offline evaluator — rebuilt TPU-first:
   audited inline waivers), compiled-program HLO audit (donation aliasing,
   precision leaks, host callbacks), generic ruff/stdlib layer
   (docs/static_analysis.md; gate: ``scripts/static_audit.py``).
+* ``memory``    — memory observability: per-buffer HBM attribution from
+  ``compiled.memory_analysis()`` (class fractions sum to 1), the OOM
+  preflight with batch/microbatch recommendations
+  (``Trainer(preflight=...)``), shared live ``memory_stats`` telemetry +
+  growth detection (docs/memory.md; gate: ``scripts/memory_probe.py``).
 * ``compat``    — JAX version shims (``shard_map`` API move, ambient-mesh
   helpers) so one codebase spans the supported JAX range.
 * ``trainer``   — the epoch-loop orchestrator with the reference's 9 hook names.

@@ -1,0 +1,71 @@
+"""Memory observability subsystem (ISSUE 8): where did the HBM go.
+
+The time-side observability stack (telemetry goodput, StepProfile device
+attribution, MFU) answers "where did the wall clock go"; this package is
+its memory twin — three layers over one sizing convention
+(``utils.hlo_flops.aval_bytes`` / ``DTYPE_BYTES``):
+
+* :mod:`~.analysis`  — per-buffer attribution of the compiled program's
+  predicted peak (``compiled.memory_analysis()`` off the abstract-aval
+  probe: zero device execution, CPU-viable) into params / optimizer state /
+  gradients / activations / input batch / executable, fractions summing to
+  1 by construction, plus a largest-buffers table;
+* :mod:`~.preflight` — fit prediction *before* the first dispatch, with a
+  bisection over abstract lowerings recommending the max batch and/or
+  microbatch factor that fits (``Trainer(preflight=...)``; ``None``
+  reproduces the historical program exactly);
+* :mod:`~.live`      — the ONE ``device.memory_stats()`` read shared by
+  bench, trainer telemetry, and preflight (live/peak bytes, per-chip skew,
+  the peak-is-process-lifetime caveat), degrading to absent fields on
+  statless backends.
+
+Wire-up: ``Trainer(preflight="on")``; window events carry ``live_bytes``;
+``telemetry.anomaly`` grows a ``memory_growth`` leak detector; see
+``docs/memory.md``. CI gate: ``scripts/memory_probe.py``.
+"""
+
+from distributed_training_pytorch_tpu.memory.analysis import (  # noqa: F401
+    BUFFER_CLASSES,
+    MemoryProfile,
+    analyze_step_memory,
+    attribute_memory,
+    memory_stats_dict,
+    predicted_peak_bytes,
+    top_buffers_from_hlo,
+)
+from distributed_training_pytorch_tpu.memory.live import (  # noqa: F401
+    device_capacity_bytes,
+    device_memory_stats,
+    is_oom_error,
+    live_memory_fields,
+    memory_skew,
+    window_memory_fields,
+)
+from distributed_training_pytorch_tpu.memory.preflight import (  # noqa: F401
+    Preflight,
+    PreflightOOMError,
+    PreflightReport,
+    resolve_preflight,
+    run_preflight,
+)
+
+__all__ = [
+    "BUFFER_CLASSES",
+    "MemoryProfile",
+    "Preflight",
+    "PreflightOOMError",
+    "PreflightReport",
+    "analyze_step_memory",
+    "attribute_memory",
+    "device_capacity_bytes",
+    "device_memory_stats",
+    "is_oom_error",
+    "live_memory_fields",
+    "memory_skew",
+    "memory_stats_dict",
+    "predicted_peak_bytes",
+    "resolve_preflight",
+    "run_preflight",
+    "top_buffers_from_hlo",
+    "window_memory_fields",
+]

@@ -1,0 +1,331 @@
+"""Per-buffer HBM attribution from the compiled program — "where did the
+memory go", the peer of the profiling subsystem's "where did the time go".
+
+Every memory limit in this repo's history was discovered by crashing into
+it (the GPT-2 LM OOM past batch 32, the ViT 384 MB/layer pallas OOM, the
+MoE einsum OOM at 65k tokens, the bench microbatch split added because
+B=4096 "OOMs on one chip"). The instrument that prevents the next one reads
+XLA's own buffer assignment — ``compiled.memory_analysis()`` — off the
+*real* single-step and chained programs, lowered on abstract avals via the
+existing ``TrainEngine.compile_step_probe`` machinery: zero device
+execution, CPU-viable like the HLO audit, dispatch executables and
+``trace_counts`` untouched.
+
+The attribution convention is the PR-6 ``StepProfile`` one: **fractions sum
+to 1 by construction**. XLA reports four byte totals (arguments, outputs,
+aliased outputs, temps) plus generated code; those are partitioned into the
+six buffer classes of :data:`BUFFER_CLASSES`:
+
+* ``params`` / ``optimizer_state`` / ``input_batch`` — the argument total,
+  pro-rated over the aval byte sizes (``utils.hlo_flops.aval_bytes``) of the
+  corresponding input leaves. Pro-rata against XLA's *reported* argument
+  bytes (rather than trusting the aval sum) keeps the partition exact when
+  the backend pads/aligns buffers;
+* ``gradients`` — the slice of the temp total up to the params' aval bytes
+  (the grad tree mirrors the master params; XLA may alias grads away, in
+  which case the class shrinks to what temp space actually exists);
+* ``activations`` — the remaining temps plus unaliased outputs. For the
+  dispatch program (donate mirrored, 100% param/opt-state aliasing enforced
+  by the static audit) unaliased outputs are just the metrics; for an
+  undonated probe the fresh output state lands here too — extra live memory
+  at peak is extra live memory, whatever its name;
+* ``executable`` — XLA's generated-code size (the program itself lives in
+  device memory on TPU).
+
+``peak_bytes = arguments + outputs - aliased + temps + code`` is the
+standard fit predictor for an XLA executable; the preflight layer
+(``memory.preflight``) compares it against device capacity *before* the
+first dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Mapping, Sequence
+
+import jax
+
+from distributed_training_pytorch_tpu.utils.hlo_flops import DTYPE_BYTES, aval_bytes
+
+__all__ = [
+    "BUFFER_CLASSES",
+    "MemoryProfile",
+    "analyze_step_memory",
+    "attribute_memory",
+    "batch_class_bytes",
+    "memory_stats_dict",
+    "predicted_peak_bytes",
+    "state_class_bytes",
+    "top_buffers_from_hlo",
+]
+
+# The exhaustive buffer-class partition, in reporting order.
+BUFFER_CLASSES = (
+    "params",
+    "optimizer_state",
+    "gradients",
+    "activations",
+    "input_batch",
+    "executable",
+)
+
+# CompiledMemoryStats attributes consumed below (device-side set only; the
+# host_* twins describe host-offloaded buffers this framework never emits).
+_STAT_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def memory_stats_dict(compiled) -> dict | None:
+    """``compiled.memory_analysis()`` flattened to a plain int dict (the
+    :data:`_STAT_FIELDS` subset), or None when the backend reports none —
+    the universal degrade-to-absent contract of ``device.memory_stats``."""
+    analysis = getattr(compiled, "memory_analysis", None)
+    if analysis is None:
+        return None
+    try:
+        stats = analysis()
+    except (NotImplementedError, RuntimeError):
+        return None
+    if stats is None:
+        return None
+    return {field: int(getattr(stats, field)) for field in _STAT_FIELDS}
+
+
+def predicted_peak_bytes(compiled) -> int | None:
+    """Predicted peak device bytes of one dispatch of ``compiled``:
+    ``arguments + outputs - aliased + temps + generated code``. None when
+    the backend exposes no memory analysis."""
+    stats = memory_stats_dict(compiled)
+    if stats is None:
+        return None
+    return _peak_from_stats(stats)
+
+
+def _peak_from_stats(stats: Mapping[str, int]) -> int:
+    return int(
+        stats["argument_size_in_bytes"]
+        + stats["output_size_in_bytes"]
+        - stats["alias_size_in_bytes"]
+        + stats["temp_size_in_bytes"]
+        + stats["generated_code_size_in_bytes"]
+    )
+
+
+def _tree_bytes(tree) -> float:
+    return float(
+        sum(
+            aval_bytes(tuple(leaf.shape), getattr(leaf, "dtype", None))
+            for leaf in jax.tree.leaves(tree)
+        )
+    )
+
+
+def state_class_bytes(state) -> dict[str, float]:
+    """Aval byte totals of a ``TrainState``'s leaves by buffer class:
+    ``params`` (master params + model collections like BN stats) and
+    ``optimizer_state`` (optax state, plus the step/rng/loss-scale
+    bookkeeping leaves — a few dozen bytes riding the bigger class)."""
+    params = _tree_bytes(getattr(state, "params", None)) + _tree_bytes(
+        getattr(state, "model_state", None)
+    )
+    optimizer = (
+        _tree_bytes(getattr(state, "opt_state", None))
+        + _tree_bytes(getattr(state, "step", None))
+        + _tree_bytes(getattr(state, "rng", None))
+        + _tree_bytes(getattr(state, "loss_scale", None))
+    )
+    return {"params": params, "optimizer_state": optimizer}
+
+
+def batch_class_bytes(batch) -> float:
+    """Aval byte total of the input batch tree (for a chained program, the
+    whole chain-stacked window — ``chain_steps`` global batches are live in
+    device memory at once, which is exactly why chained windows move the
+    fit boundary)."""
+    return _tree_bytes(batch)
+
+
+# One optimized-HLO definition line: `%name = dtype[dims]{layout} opcode(`.
+# Tuple-shaped defs (while carries, fusion roots) deliberately don't match —
+# their bytes are the element buffers', each defined on its own line.
+_BUF_RE = re.compile(
+    r"^(?:ROOT )?%([\w.\-]+) = (\w+)\[([0-9,]*)\](?:\{[^}]*\})? ([\w\-]+)\("
+)
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def top_buffers_from_hlo(hlo_text: str, top_k: int = 10) -> list[dict]:
+    """The ``top_k`` largest buffers of an optimized-HLO module: one row per
+    instruction output — ``{name, op, shape, dtype, bytes, op_name}`` —
+    sized with the same dtype-width table ``aval_bytes`` uses
+    (``utils.hlo_flops.DTYPE_BYTES``), so the largest-buffers table and the
+    class attribution account memory identically. ``op_name`` is the origin
+    op from HLO metadata when present (the model-level name of the op that
+    produced the buffer)."""
+    if top_k <= 0:
+        return []
+    rows: list[dict] = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        m = _BUF_RE.match(line)
+        if not m:
+            continue
+        name, dtype, dims_s, op = m.groups()
+        dims = tuple(int(x) for x in dims_s.split(",") if x)
+        n = 1
+        for d in dims:
+            n *= d
+        origin = _OPNAME_RE.search(line)
+        rows.append(
+            {
+                "name": name,
+                "op": op,
+                "shape": list(dims),
+                "dtype": dtype,
+                "bytes": int(n * DTYPE_BYTES.get(dtype, 4)),
+                "op_name": origin.group(1) if origin else "",
+            }
+        )
+    rows.sort(key=lambda r: r["bytes"], reverse=True)
+    return rows[:top_k]
+
+
+@dataclasses.dataclass
+class MemoryProfile:
+    """Peak-HBM attribution of one compiled step (or chained window).
+
+    ``bytes_by_class`` partitions ``peak_bytes`` over :data:`BUFFER_CLASSES`
+    exactly (sum == peak by construction, so :meth:`fractions` sum to 1);
+    ``stats`` carries the raw ``CompiledMemoryStats`` totals the partition
+    was derived from; ``top_buffers`` the largest-buffer rows."""
+
+    peak_bytes: int
+    bytes_by_class: dict[str, float]
+    stats: dict[str, int]
+    top_buffers: list[dict] = dataclasses.field(default_factory=list)
+    chain_length: int | None = None
+
+    def fractions(self) -> dict[str, float]:
+        if self.peak_bytes <= 0:
+            return {c: 0.0 for c in BUFFER_CLASSES}
+        return {c: v / self.peak_bytes for c, v in self.bytes_by_class.items()}
+
+    def to_fields(self) -> dict:
+        """Flat JSON-safe payload for events / bench lines."""
+        return {
+            "predicted_peak_bytes": int(self.peak_bytes),
+            "bytes_by_class": {k: int(v) for k, v in self.bytes_by_class.items()},
+            "fractions": {k: round(v, 4) for k, v in self.fractions().items()},
+            **({"chain_length": self.chain_length} if self.chain_length else {}),
+        }
+
+
+def attribute_memory(
+    stats: Mapping[str, int],
+    input_class_bytes: Mapping[str, float],
+    grad_bytes: float,
+    *,
+    top_buffers: Sequence[dict] = (),
+    chain_length: int | None = None,
+) -> MemoryProfile:
+    """Pure-arithmetic attribution core (hand-testable without XLA).
+
+    ``stats`` is a :func:`memory_stats_dict`; ``input_class_bytes`` the aval
+    byte totals of the argument leaves per class (``params`` /
+    ``optimizer_state`` / ``input_batch``); ``grad_bytes`` the params' aval
+    bytes (the gradient tree's size). See the module docstring for the
+    partition rules."""
+    arg = float(stats["argument_size_in_bytes"])
+    out = float(stats["output_size_in_bytes"])
+    alias = float(stats["alias_size_in_bytes"])
+    temp = float(stats["temp_size_in_bytes"])
+    code = float(stats["generated_code_size_in_bytes"])
+
+    classes = {c: 0.0 for c in BUFFER_CLASSES}
+    in_total = sum(input_class_bytes.get(c, 0.0) for c in ("params", "optimizer_state", "input_batch"))
+    if in_total > 0:
+        for c in ("params", "optimizer_state", "input_batch"):
+            classes[c] = arg * (input_class_bytes.get(c, 0.0) / in_total)
+        spill = 0.0
+    else:
+        spill = arg  # no classable inputs: the argument total is workspace
+    grads = min(temp, max(0.0, float(grad_bytes)))
+    classes["gradients"] = grads
+    classes["activations"] = (temp - grads) + (out - alias) + spill
+    classes["executable"] = code
+    return MemoryProfile(
+        peak_bytes=_peak_from_stats(stats),
+        bytes_by_class=classes,
+        stats=dict(stats),
+        top_buffers=list(top_buffers),
+        chain_length=chain_length,
+    )
+
+
+def _abstract_tree(tree) -> Any:
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), tree
+    )
+
+
+def stack_chain_batch(batch, chain_length: int) -> Any:
+    """The chain-stacked abstract window for a per-step batch: every leaf
+    gains a leading ``chain_length`` axis (the ``device_prefetch_chained``
+    staging layout the chained program consumes)."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((int(chain_length),) + tuple(x.shape), x.dtype),
+        batch,
+    )
+
+
+def analyze_step_memory(
+    engine,
+    state,
+    batch,
+    *,
+    donate: bool = True,
+    chain_length: int | None = None,
+    top_k: int = 10,
+) -> MemoryProfile:
+    """Attribute the peak HBM of the engine's real step program.
+
+    ``batch`` is the PER-STEP batch (arrays or ``ShapeDtypeStruct``s);
+    ``chain_length=N`` analyzes the chained-window program over the
+    chain-stacked batch instead (N global batches live at once). ``donate``
+    mirrors the dispatch path's donation by default — the program whose fit
+    matters is the one the trainer runs. Lowering happens on abstract avals
+    via ``TrainEngine.compile_step_probe`` (memoized; no device execution,
+    no trace-count side effects). Raises ``ValueError`` when the backend
+    reports no memory analysis — callers degrade, never guess."""
+    batch = _abstract_tree(batch)
+    probe_batch = (
+        stack_chain_batch(batch, chain_length) if chain_length else batch
+    )
+    compiled = engine.compile_step_probe(
+        state, probe_batch, donate=donate, chain_length=chain_length
+    )
+    stats = memory_stats_dict(compiled)
+    if stats is None:
+        raise ValueError(
+            "backend reports no memory analysis for the compiled step — "
+            "memory attribution unavailable on this platform"
+        )
+    input_classes = dict(state_class_bytes(state))
+    input_classes["input_batch"] = batch_class_bytes(probe_batch)
+    grad_bytes = _tree_bytes(getattr(state, "params", None))
+    top = (
+        top_buffers_from_hlo(compiled.as_text(), top_k) if top_k > 0 else []
+    )
+    return attribute_memory(
+        stats,
+        input_classes,
+        grad_bytes,
+        top_buffers=top,
+        chain_length=chain_length,
+    )

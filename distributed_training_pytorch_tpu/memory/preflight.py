@@ -1,0 +1,361 @@
+"""OOM pre-flight: predict fit *before* the first dispatch, and when the
+configured program cannot fit, say what would.
+
+Every historical OOM hunt in this repo was trial-and-error on real
+hardware: shrink the batch, re-launch, wait out the compile, crash again.
+The whole loop is computable host-side — ``compiled.memory_analysis()`` of
+the abstractly-lowered program predicts peak bytes without executing
+anything — so the preflight turns it into one structured report:
+
+* predict the configured program's peak (chained window included — that IS
+  the dispatched program) via :func:`memory.analysis.analyze_step_memory`;
+* compare against per-device capacity minus a headroom margin
+  (fragmentation, collectives scratch, the allocator's own slack);
+* on predicted OOM, **bisect over abstract lowerings** for the largest
+  batch that fits, and probe doubling grad-accumulation factors for the
+  smallest microbatch split that keeps the full batch — then fail fast
+  (``action="raise"``) with both recommendations in the error, before any
+  device ever allocates a byte.
+
+``Trainer(preflight=...)`` wires this in front of the first real compile;
+``preflight=None`` (the default) reproduces the historical program exactly
+(trace_counts + params parity, test-enforced — the telemetry/profiling
+convention). Each bisection trial pays one abstract XLA compile; that
+one-time cost is booked to the goodput ``compile`` bucket by the trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+
+from distributed_training_pytorch_tpu.memory import analysis as mem_analysis
+from distributed_training_pytorch_tpu.memory import live as mem_live
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+__all__ = [
+    "Preflight",
+    "PreflightOOMError",
+    "PreflightReport",
+    "resolve_preflight",
+    "run_preflight",
+]
+
+
+class PreflightOOMError(RuntimeError):
+    """Predicted OOM (``action="raise"``): the configured program does not
+    fit device memory. ``.report`` carries the full :class:`PreflightReport`
+    including the batch / microbatch recommendations."""
+
+    def __init__(self, message: str, report: "PreflightReport | None" = None):
+        super().__init__(message)
+        self.report = report
+
+
+@dataclasses.dataclass
+class Preflight:
+    """The ``Trainer(preflight=...)`` configuration bundle.
+
+    * ``capacity_bytes`` — per-device memory budget. None = read
+      ``bytes_limit`` from ``device.memory_stats()`` (TPU); on backends
+      without stats (CPU) the fit check is skipped and the prediction is
+      still recorded/emitted;
+    * ``headroom``       — fraction of capacity held back (fragmentation,
+      collective scratch): the program must fit in
+      ``capacity * (1 - headroom)``;
+    * ``action``         — ``"raise"`` (default: fail fast before dispatch)
+      or ``"warn"`` (log + event, train on — for runs probing the boundary);
+    * ``recommend``      — bisect for the max fitting batch and probe
+      microbatch factors on predicted OOM (each trial = one abstract
+      compile);
+    * ``top_k``          — largest-buffer rows in the report;
+    * ``max_trials``     — abstract-compile budget for the recommendation
+      search.
+    """
+
+    capacity_bytes: int | None = None
+    headroom: float = 0.1
+    action: str = "raise"
+    recommend: bool = True
+    top_k: int = 8
+    max_trials: int = 12
+
+    def __post_init__(self):
+        if self.action not in ("raise", "warn"):
+            raise ValueError(f"action must be 'raise' or 'warn', got {self.action!r}")
+        if not 0.0 <= float(self.headroom) < 1.0:
+            raise ValueError(f"headroom must be in [0, 1), got {self.headroom!r}")
+
+
+def resolve_preflight(spec) -> Preflight | None:
+    """Trainer-knob resolution (the ``resolve_telemetry`` convention):
+    ``None``/``False`` = off — the historical program, byte-for-byte;
+    ``True``/``"on"``/``"check"`` = defaults; a :class:`Preflight` instance
+    passes through."""
+    if spec is None or spec is False:
+        return None
+    if spec is True:
+        return Preflight()
+    if isinstance(spec, str):
+        key = spec.lower()
+        if key in ("on", "1", "true", "check", "default"):
+            return Preflight()
+        if key in ("off", "0", "false", "none"):
+            return None
+        raise ValueError(
+            f"unknown preflight spec {spec!r} (use 'on', 'off', or a Preflight)"
+        )
+    if isinstance(spec, Preflight):
+        return spec
+    raise TypeError(
+        f"preflight must be None, bool, str, or Preflight, got {type(spec)}"
+    )
+
+
+@dataclasses.dataclass
+class PreflightReport:
+    """The structured verdict. ``fits`` is None when capacity is unknown
+    (prediction recorded, check skipped). Recommendations are populated
+    only on predicted OOM: ``recommended_batch`` is the largest global
+    batch (a multiple of the mesh's batch-shard granularity) whose
+    predicted peak fits; ``recommended_accum`` the smallest
+    grad-accumulation factor that fits the FULL configured batch (None
+    where no candidate fits / divides)."""
+
+    predicted_peak_bytes: int
+    batch_size: int
+    profile: mem_analysis.MemoryProfile
+    capacity_bytes: int | None = None
+    usable_bytes: int | None = None
+    headroom: float = 0.0
+    fits: bool | None = None
+    chain_length: int | None = None
+    recommended_batch: int | None = None
+    recommended_accum: int | None = None
+    trials: int = 0
+    seconds: float = 0.0
+
+    def to_fields(self) -> dict:
+        """Flat JSON-safe payload for the ``memory_preflight`` event."""
+        fields = {
+            "fits": self.fits,
+            "batch_size": self.batch_size,
+            "capacity_bytes": self.capacity_bytes,
+            "usable_bytes": self.usable_bytes,
+            "headroom": self.headroom,
+            "recommended_batch": self.recommended_batch,
+            "recommended_accum": self.recommended_accum,
+            "trials": self.trials,
+            "seconds": round(self.seconds, 3),
+            "top_buffers": self.profile.top_buffers[:5],
+            **self.profile.to_fields(),
+        }
+        return fields
+
+
+def _leading_dim(batch) -> int:
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        raise ValueError("preflight: batch tree has no leaves")
+    return int(leaves[0].shape[0])
+
+
+def _batch_shard(mesh) -> int:
+    """The batch-dim sharding granularity: global batches must be multiples
+    of the mesh extent over the batch axes (``parallel.mesh.batch_sharding``
+    shards dim 0 over data x fsdp)."""
+    shard = 1
+    for axis in (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS):
+        shard *= int(mesh.shape.get(axis, 1))
+    return max(1, shard)
+
+
+def _resize_batch(batch, new_leading: int):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            (int(new_leading),) + tuple(x.shape[1:]), x.dtype
+        ),
+        batch,
+    )
+
+
+def _format_bytes(n: int | float | None) -> str:
+    if n is None:
+        return "unknown"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(value) < 1024.0 or unit == "GiB":
+            return f"{value:.2f} {unit}" if unit != "B" else f"{int(value)} B"
+        value /= 1024.0
+    return f"{value:.2f} GiB"
+
+
+def run_preflight(
+    engine,
+    state,
+    batch,
+    config: Preflight,
+    *,
+    chain_length: int | None = None,
+    log=None,
+    events=None,
+) -> "PreflightReport | None":
+    """Predict, check, recommend. ``batch`` is the PER-STEP global batch
+    (arrays or ``ShapeDtypeStruct``s); ``chain_length`` analyzes the
+    chained-window program when the trainer dispatches windows. ``events``
+    (an ``EventLog`` or None) receives one ``memory_preflight`` record;
+    ``log`` the trainer's ``log(msg, log_type)`` closure. Raises
+    :class:`PreflightOOMError` on predicted OOM under ``action="raise"``.
+
+    Returns None (with a warning and a ``skipped`` event) when the backend
+    exposes no ``memory_analysis`` at all — an observability knob must
+    degrade on an unsupported platform, never kill training."""
+    say = log if log is not None else (lambda msg, log_type="info": None)
+    t0 = time.perf_counter()
+    try:
+        profile = mem_analysis.analyze_step_memory(
+            engine, state, batch, chain_length=chain_length, top_k=config.top_k
+        )
+    except ValueError as e:
+        say(f"memory preflight skipped: {e}", "warning")
+        if events is not None:
+            events.emit("memory_preflight", skipped=True, reason=str(e))
+        return None
+    report = PreflightReport(
+        predicted_peak_bytes=profile.peak_bytes,
+        batch_size=_leading_dim(batch),
+        profile=profile,
+        headroom=float(config.headroom),
+        chain_length=chain_length,
+    )
+    capacity = config.capacity_bytes
+    if capacity is None:
+        capacity = mem_live.device_capacity_bytes()
+    if capacity is None:
+        say(
+            "memory preflight: device reports no capacity (memory_stats "
+            f"absent on this backend) — predicted peak "
+            f"{_format_bytes(profile.peak_bytes)} recorded, fit check skipped",
+            "warning",
+        )
+    else:
+        report.capacity_bytes = int(capacity)
+        report.usable_bytes = int(capacity * (1.0 - config.headroom))
+        report.fits = profile.peak_bytes <= report.usable_bytes
+        if not report.fits and config.recommend:
+            _recommend(engine, state, batch, config, report, chain_length)
+    report.seconds = time.perf_counter() - t0
+    if events is not None:
+        events.emit("memory_preflight", **report.to_fields())
+    if report.fits is False:
+        message = _failure_message(report)
+        if config.action == "raise":
+            raise PreflightOOMError(message, report=report)
+        say(message, "warning")
+    elif report.fits:
+        say(
+            f"memory preflight: predicted peak "
+            f"{_format_bytes(profile.peak_bytes)} fits "
+            f"{_format_bytes(report.usable_bytes)} usable "
+            f"({_format_bytes(report.capacity_bytes)} capacity, "
+            f"{config.headroom:.0%} headroom)"
+        )
+    return report
+
+
+def _predict(engine, state, batch, chain_length, report) -> int:
+    """One recommendation trial = one THROWAWAY abstract compile.
+    Deliberately not ``engine.compile_step_probe``: the probe cache
+    memoizes loaded executables per shape for the process lifetime, and
+    under ``action="warn"`` (a run deliberately probing the boundary — on
+    a memory-constrained device, exactly when it matters) up to
+    ``max_trials`` never-again-used executables would stay resident. The
+    accum trials' ``with_accum`` twins are throwaway for the same reason."""
+    report.trials += 1
+    probe_batch = (
+        mem_analysis.stack_chain_batch(batch, chain_length) if chain_length else batch
+    )
+    compiled = engine.lower_step_probe(
+        state, probe_batch, donate=True, chain_length=chain_length
+    ).compile()
+    peak = mem_analysis.predicted_peak_bytes(compiled)
+    if peak is None:  # unreachable: the initial analysis on this backend succeeded
+        raise ValueError("backend stopped reporting memory analysis mid-preflight")
+    return peak
+
+
+def _recommend(engine, state, batch, config, report, chain_length) -> None:
+    """Populate ``recommended_batch`` / ``recommended_accum``. Peak memory
+    is monotone in batch size (activations and the staged input grow with
+    it; everything else is constant), so bisection over the shard-multiple
+    grid finds the exact boundary in log2 trials."""
+    usable = report.usable_bytes
+    shard = _batch_shard(engine.mesh)
+    full = report.batch_size
+    # -- max fitting batch (bisection over multiples of the shard size) ---
+    if full > shard and report.trials < config.max_trials:
+        if _predict(engine, state, _resize_batch(batch, shard), chain_length, report) <= usable:
+            lo, hi = 1, full // shard  # lo*shard fits, hi*shard does not
+            while hi - lo > 1 and report.trials < config.max_trials:
+                mid = (lo + hi) // 2
+                peak = _predict(
+                    engine, state, _resize_batch(batch, mid * shard), chain_length, report
+                )
+                if peak <= usable:
+                    lo = mid
+                else:
+                    hi = mid
+            report.recommended_batch = lo * shard
+    # -- smallest microbatch factor keeping the full batch ---------------
+    factor = 2
+    base_accum = max(1, int(engine.accum_steps))
+    while report.trials < config.max_trials:
+        accum = base_accum * factor
+        micro = full // accum
+        if micro < 1 or full % accum or micro % shard:
+            break
+        trial_engine = engine.with_accum(accum)
+        if _predict(trial_engine, state, batch, chain_length, report) <= usable:
+            report.recommended_accum = accum
+            break
+        factor *= 2
+
+
+def _failure_message(report: PreflightReport) -> str:
+    lines = [
+        "memory preflight: predicted OOM — "
+        f"peak {_format_bytes(report.predicted_peak_bytes)} exceeds "
+        f"{_format_bytes(report.usable_bytes)} usable "
+        f"({_format_bytes(report.capacity_bytes)} capacity - "
+        f"{report.headroom:.0%} headroom) "
+        f"at global batch {report.batch_size}"
+        + (f", chained x{report.chain_length}" if report.chain_length else ""),
+    ]
+    fractions = report.profile.fractions()
+    split = ", ".join(
+        f"{cls} {_format_bytes(report.profile.bytes_by_class[cls])} "
+        f"({fractions[cls]:.0%})"
+        for cls in mem_analysis.BUFFER_CLASSES
+        if report.profile.bytes_by_class.get(cls)
+    )
+    lines.append(f"  attribution: {split}")
+    if report.recommended_batch is not None:
+        lines.append(
+            f"  recommendation: batch {report.recommended_batch} fits "
+            f"(largest shard-aligned batch under the limit, "
+            f"{report.trials} abstract lowerings)"
+        )
+    if report.recommended_accum is not None:
+        lines.append(
+            f"  recommendation: accum_steps={report.recommended_accum} fits the "
+            f"full batch {report.batch_size} (microbatch "
+            f"{report.batch_size // report.recommended_accum})"
+        )
+    if report.recommended_batch is None and report.recommended_accum is None:
+        lines.append(
+            "  no fitting configuration found (params + optimizer state may "
+            "exceed capacity outright — shard the model, ROADMAP item 1)"
+        )
+    return "\n".join(lines)

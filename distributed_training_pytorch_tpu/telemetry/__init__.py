@@ -87,7 +87,13 @@ class Telemetry:
     * ``flops_per_step`` — analytic per-step FLOP override (skips the probe;
       e.g. ``bench.vgg16_train_flops_per_image(model, size) * batch``);
     * ``anomaly``        — ``"warn"`` (default) | ``"raise"`` | ``None`` |
-      an :class:`AnomalyDetector` instance with custom thresholds.
+      an :class:`AnomalyDetector` instance with custom thresholds;
+    * ``memory``         — live device-memory fields (``live_bytes`` /
+      ``peak_bytes`` from ``memory.live``, plus per-chip skew on multi-chip
+      hosts) on the per-window records, read at the existing ``log_every``
+      host syncs (a PJRT allocator query — zero extra device syncs), and
+      fed to the anomaly detector's ``memory_growth`` leak check. Degrades
+      to absent fields on backends without ``memory_stats`` (CPU).
     """
 
     events_path: str | None = None
@@ -96,6 +102,7 @@ class Telemetry:
     mfu: bool = True
     flops_per_step: float | None = None
     anomaly: AnomalyDetector | str | None = "warn"
+    memory: bool = True
 
     def resolve_anomaly(self) -> AnomalyDetector | None:
         if self.anomaly is None:

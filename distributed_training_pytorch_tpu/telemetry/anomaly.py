@@ -1,5 +1,5 @@
 """Host-side anomaly detectors: loss spikes, grad explosions, step-time
-regressions.
+regressions, and live-memory growth.
 
 These run ONLY at the trainer's existing host sync points (``log_every``
 boundaries and epoch end), on metric values the sync already fetched —
@@ -31,8 +31,10 @@ class AnomalyError(RuntimeError):
 @dataclasses.dataclass
 class Anomaly:
     """One finding: ``kind`` is ``loss_spike`` | ``grad_explosion`` |
-    ``step_time_regression``; ``value`` tripped at ``factor`` x
-    ``baseline`` (the EWMA at detection time) at global step ``step``."""
+    ``step_time_regression`` | ``memory_growth``; ``value`` tripped at
+    ``factor`` x ``baseline`` (the EWMA at detection time — or, for
+    ``memory_growth``, the steady-state live-byte floor) at global step
+    ``step``."""
 
     kind: str
     step: int
@@ -55,6 +57,17 @@ class AnomalyDetector:
     non-finite value still fires); ``ewma_alpha`` the baseline's
     smoothing; ``warmup`` the observations per signal before it can fire
     (compile-skewed first windows and init-transient losses are normal).
+
+    ``memory_growth`` watches the trainer's per-window ``live_bytes``
+    (``memory.live``) differently: steady-state live bytes must be FLAT
+    across windows — every step's buffers are freed or reused by the next —
+    so its baseline is the post-warmup **minimum** (the steady-state floor),
+    not an EWMA. An EWMA would *track* a slow leak and never alarm; a floor
+    cannot be dragged upward, so a host-side buffer leak (a prefetch queue
+    that stops draining — the exact bug class of the PR-2 shutdown race — a
+    metrics list pinning device arrays) eventually crosses
+    ``factor x floor`` no matter how gradual the slope. Signals whose value
+    is absent (statless backends pass ``live_bytes=None``) never fire.
     """
 
     def __init__(
@@ -64,6 +77,7 @@ class AnomalyDetector:
         loss_spike: float | None = 3.0,
         grad_explosion: float | None = 10.0,
         step_time_regression: float | None = 2.5,
+        memory_growth: float | None = 1.5,
         ewma_alpha: float = 0.1,
         warmup: int = 5,
     ):
@@ -75,10 +89,12 @@ class AnomalyDetector:
             "grad_explosion": grad_explosion,
             "step_time_regression": step_time_regression,
         }
+        self.memory_growth = memory_growth
         self.ewma_alpha = float(ewma_alpha)
         self.warmup = int(warmup)
         self._ewma: dict[str, float] = {}
         self._seen: dict[str, int] = {}
+        self._mem_floor: float | None = None
         self.total_fired = 0
 
     def _check(self, kind: str, value: float | None, step: int) -> Anomaly | None:
@@ -108,6 +124,29 @@ class AnomalyDetector:
         self._seen[kind] = seen + 1
         return anomaly
 
+    def _check_memory(self, value: float | None, step: int) -> Anomaly | None:
+        """Floor-baselined leak detection (see class docstring): warmup
+        observations pass untracked (allocator ramp — caches, prefetch
+        staging — is normal), then the running minimum is the steady-state
+        floor and a value above ``memory_growth x floor`` is a leak. The
+        floor only ever moves DOWN, so it never absorbs the leak it is
+        there to catch."""
+        if value is None or self.memory_growth is None:
+            return None
+        value = float(value)
+        seen = self._seen.get("memory_growth", 0)
+        self._seen["memory_growth"] = seen + 1
+        if seen < self.warmup or not math.isfinite(value):
+            return None
+        floor = self._mem_floor
+        if floor is None:
+            self._mem_floor = value
+            return None
+        self._mem_floor = min(floor, value)
+        if value > self.memory_growth * floor:
+            return Anomaly("memory_growth", step, value, floor, self.memory_growth)
+        return None
+
     def observe(
         self,
         step: int,
@@ -115,6 +154,7 @@ class AnomalyDetector:
         loss: float | None = None,
         grad_norm: float | None = None,
         step_time: float | None = None,
+        live_bytes: float | None = None,
     ) -> list[Anomaly]:
         """Feed one sync point's values; returns the anomalies fired (empty
         list almost always). ``step`` labels findings only."""
@@ -127,5 +167,8 @@ class AnomalyDetector:
             a = self._check(kind, value, int(step))
             if a is not None:
                 found.append(a)
+        a = self._check_memory(live_bytes, int(step))
+        if a is not None:
+            found.append(a)
         self.total_fired += len(found)
         return found

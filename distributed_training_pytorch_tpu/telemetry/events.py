@@ -5,15 +5,19 @@ The framework's narrative observability so far lived in free-text log lines
 "how many preemptions did this run survive?" meant regexing a logfile. The
 event log records the run's *discrete* happenings — run start/end,
 compilation, checkpoint save/restore, preemption, fault injection,
-loss-scale backoff, anomaly, profiling captures (``profile_capture``: trace
-path, traced window, category fractions + dispatch-gap audit, emitted by
-``profiling.StepTraceCapture``), perf-gate verdicts (``perf_gate``:
-measured vs baseline, tolerance, verdict, emitted by
-``scripts/perf_gate.py``), and static-audit verdicts (``static_audit``:
+loss-scale backoff, anomaly (including ``kind="memory_growth"``, the
+live-memory leak detector — the "memory anomaly"), profiling captures
+(``profile_capture``: trace path, traced window, category fractions +
+dispatch-gap audit, emitted by ``profiling.StepTraceCapture``), perf-gate
+verdicts (``perf_gate``: measured vs baseline, tolerance, verdict, emitted
+by ``scripts/perf_gate.py``), static-audit verdicts (``static_audit``:
 per-rule lint counts, waiver counts, undonated param/opt-state bytes of
 the single-step and chained programs, precision leaks, host callbacks,
-emitted by ``scripts/static_audit.py --events``) — as one JSON object per
-line, machine-readable and append-only.
+emitted by ``scripts/static_audit.py --events``), and memory-preflight
+verdicts (``memory_preflight``: predicted peak vs capacity, per-class
+attribution, batch/microbatch recommendations, emitted by
+``memory.preflight.run_preflight`` before the first dispatch) — as one
+JSON object per line, machine-readable and append-only.
 
 Conventions:
 

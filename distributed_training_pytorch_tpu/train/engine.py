@@ -607,6 +607,31 @@ class TrainEngine:
         self._step_probe_cache[key] = compiled
         return compiled
 
+    def with_accum(self, accum_steps: int) -> "TrainEngine":
+        """An observability twin of this engine at a different
+        grad-accumulation factor — same loss fn, optimizer, mesh, precision,
+        guard, and donation, fresh jit caches. ``memory.preflight`` probes
+        these (abstract lowerings only, never dispatched) to recommend the
+        microbatch factor that fits device memory; the twin shares nothing
+        with this engine's executables, so probing it cannot perturb the
+        dispatch path."""
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        return TrainEngine(
+            self.loss_fn,
+            self.optimizer,
+            self.mesh,
+            accum_steps=accum_steps,
+            schedule=self.schedule,
+            donate_state=bool(self._donate),
+            sharding_rules=self.sharding_rules,
+            fsdp_min_size=self.fsdp_min_size,
+            nan_guard=self.nan_guard,
+            precision=self.precision,
+            loss_scale=self.initial_loss_scale,
+            stats=self.stats,
+        )
+
     def step_cost_analysis(self, state, batch) -> dict:
         """XLA's cost analysis (FLOPs, bytes accessed, ...) of ONE train step
         for these shapes — the telemetry MFU probe, via

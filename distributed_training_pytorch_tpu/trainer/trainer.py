@@ -66,6 +66,11 @@ from distributed_training_pytorch_tpu.data import (
     device_prefetch_chained,
 )
 from distributed_training_pytorch_tpu.fault.watchdog import StepWatchdog
+from distributed_training_pytorch_tpu.memory import (
+    resolve_preflight,
+    run_preflight,
+    window_memory_fields,
+)
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.precision import (
     get_policy,
@@ -135,6 +140,7 @@ class Trainer:
         loss_scale=None,
         telemetry=None,
         profile=None,
+        preflight=None,
     ):
         # Logger closure — exact contract of ``trainer/trainer.py:26``.
         self.log = (
@@ -347,6 +353,17 @@ class Trainer:
         # Recovery skips (restore_latest_valid walking past a corrupt
         # checkpoint) land in the event log as `checkpoint_rejected` records.
         self.checkpoints.event_log = self.events
+        # Memory preflight (ISSUE 8; memory/preflight.py): predict the
+        # configured program's peak HBM from an abstract lowering BEFORE the
+        # first real compile, fail fast on predicted OOM with a batch/
+        # microbatch recommendation. preflight=None (default) reproduces the
+        # historical program exactly — no lowering, no probe, trace_counts +
+        # params parity test-enforced (the telemetry/profile convention).
+        self.preflight = resolve_preflight(preflight)
+        self._preflight_done = False
+        # The last PreflightReport (fit verdict, per-class attribution,
+        # recommendations) — operator-inspectable after train().
+        self.memory_report = None
         # Hot-path profiling capture (profiling/capture.py): one traced
         # window of real steps, driven at unit boundaries in train_epoch.
         # Rank-0 owned; events no-op when telemetry is off.
@@ -857,6 +874,58 @@ class Trainer:
             flops_per_step=self._flops_per_step,
         )
 
+    def _run_memory_preflight(self, n: int, batch, *, can_chain: bool) -> None:
+        """One-shot OOM preflight on the first execution unit's abstract
+        shapes (``memory.preflight.run_preflight``): predicted peak vs
+        per-device capacity, a ``memory_preflight`` event, and on predicted
+        OOM a fail-fast :class:`~memory.PreflightOOMError` carrying the
+        max-batch / microbatch recommendations. ``can_chain`` gates the
+        chained-window prediction (the caller knows whether a full window
+        can still occur this epoch — conservative at window granularity:
+        lead-single realignment may rarely leave the last possible window
+        unformed, in which case the verdict covers a slightly larger
+        program than dispatches). Skipped (with a warning) under a custom
+        ``train_step`` override — the engine's program is not the one
+        dispatched, so its prediction would be for the wrong program (the
+        MFU-probe rule)."""
+        self._preflight_done = True
+        if type(self).train_step is not Trainer.train_step:
+            self.log(
+                "memory preflight skipped: custom train_step override — the "
+                "engine program the preflight would lower is not the one "
+                "this trainer dispatches",
+                "warning",
+            )
+            return
+        per_step = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape if n == 1 else x.shape[1:], x.dtype
+            ),
+            batch,
+        )
+        self.memory_report = run_preflight(
+            self.engine,
+            self.state,
+            per_step,
+            self.preflight,
+            chain_length=self.chain_steps if can_chain else None,
+            log=self.log,
+            events=self.events,
+        )
+
+    def _live_memory_fields(self) -> dict:
+        """Per-window live device memory (``memory.live`` — the one
+        memory_stats read): ``live_bytes``/``peak_bytes`` plus per-chip
+        skew on multi-chip hosts. Read only at existing host sync points
+        (an allocator query, zero device syncs); ``{}`` on statless
+        backends — the records simply omit the fields. ``peak_bytes`` is
+        the allocator's process-lifetime high-water mark (documented
+        caveat): the per-window signal — and the growth detector's input —
+        is ``live_bytes``."""
+        if self.telemetry is None or not getattr(self.telemetry, "memory", True):
+            return {}
+        return window_memory_fields()
+
     def _profile_flops_index(self):
         """Per-op roofline join table for the profile capture's top-op rows
         (``profiling.report.flops_index`` over the engine's observability
@@ -1117,8 +1186,13 @@ class Trainer:
                         peak_flops=self._peak_flops,
                     )
                     self._last_step_ms = report["step_ms"]
+                    mem_fields = self._live_memory_fields()
                     self.events.emit(
-                        "window", epoch=epoch, step_in_epoch=step_in_epoch, **report
+                        "window",
+                        epoch=epoch,
+                        step_in_epoch=step_in_epoch,
+                        **report,
+                        **mem_fields,
                     )
                     scale = m.get("loss_scale")
                     if scale is not None:
@@ -1141,6 +1215,7 @@ class Trainer:
                                 loss=m.get("loss", m.get("ce_loss")),
                                 grad_norm=m.get("grad_norm"),
                                 step_time=report["step_ms"] / 1e3,
+                                live_bytes=mem_fields.get("live_bytes"),
                             ),
                             epoch=epoch,
                             step_in_epoch=step_in_epoch,
@@ -1173,6 +1248,27 @@ class Trainer:
                 if tm is not None:
                     tm.tick("restart_rollback" if rollback_fetch else "data_wait")
                 rollback_fetch = False
+                if self.preflight is not None and not self._preflight_done:
+                    # Before the first dispatch (nothing compiled yet): the
+                    # unit's shapes are exact, the fit verdict covers the
+                    # REAL program — the chained window when one can still
+                    # occur this epoch (remaining steps >= chain_steps;
+                    # an epoch shorter than one window only ever dispatches
+                    # singles, and a verdict on the never-dispatched window
+                    # program could fail a run whose real program fits).
+                    # Predicted OOM raises out of the loop — failing fast
+                    # host-side is the whole point. The abstract lowerings
+                    # are one-time XLA compile work: booked to the `compile`
+                    # bucket so goodput stays honest about the new startup
+                    # cost.
+                    self._run_memory_preflight(
+                        n,
+                        batch,
+                        can_chain=chain > 1
+                        and num_batches - step_in_epoch >= chain,
+                    )
+                    if tm is not None:
+                        tm.tick("compile")
                 if self.telemetry is not None:
                     trace_base[0] = sum(self.engine.trace_counts.values())
                 if (
@@ -1329,6 +1425,7 @@ class Trainer:
                 for k in ("loss", "ce_loss", "grad_norm", "update_ratio", "nonfinite")
                 if k in out
             }
+            mem_fields = self._live_memory_fields()
             self.events.emit(
                 "epoch_end",
                 epoch=epoch,
@@ -1336,6 +1433,7 @@ class Trainer:
                 interrupted=self._epoch_interrupted,
                 **report,
                 **health,
+                **mem_fields,
             )
             if self.anomaly_detector is not None:
                 self._report_anomalies(
@@ -1344,6 +1442,7 @@ class Trainer:
                         loss=out.get("loss", out.get("ce_loss")),
                         grad_norm=out.get("grad_norm"),
                         step_time=report["step_ms"] / 1e3,
+                        live_bytes=mem_fields.get("live_bytes"),
                     ),
                     epoch=epoch,
                     step_in_epoch=step_in_epoch,

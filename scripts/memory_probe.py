@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Memory-accounting CI gate (ISSUE 8 satellite).
+
+Runs the memory preflight on the digits config (the offline stand-in every
+accuracy clause uses) and asserts the subsystem's core contracts, the way
+the retrace/precision/telemetry/perf gates assert theirs:
+
+* **prediction parity**: the preflight's predicted peak equals the number
+  re-derived here from ``compiled.memory_analysis()`` by stdlib arithmetic
+  (argument + output - alias + temp + code) — on BOTH the real single-step
+  and the real chained (window) programs. The re-derivation is independent
+  of ``memory/analysis.py`` (the chaos-soak "independent re-validation"
+  pattern), so a drift between the preflight's math and XLA's buffer
+  assignment fails here, not as a wrong fit verdict on real hardware;
+* **exhaustive attribution**: buffer-class fractions sum to 1 on both
+  programs, every class non-negative, and the largest-buffer table is
+  populated;
+* **``--inject-oversize`` self-test** (the perf-gate/static-audit "gate
+  has teeth" pattern): a deliberately unfittable capacity — midway between
+  the smallest shard-aligned batch's peak and the configured batch's peak —
+  MUST make the preflight FAIL with a finite batch recommendation whose
+  predicted peak actually fits. A preflight that waves an oversized config
+  through, or fails without a recommendation, exits nonzero.
+
+CPU-viable end to end: every number comes from abstract lowerings — no
+device execution, no allocator required.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_training_pytorch_tpu.memory import (
+    BUFFER_CLASSES,
+    Preflight,
+    PreflightOOMError,
+    analyze_step_memory,
+    run_preflight,
+)
+from distributed_training_pytorch_tpu.memory.analysis import stack_chain_batch
+from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+
+CHAIN = 2
+BATCH = 128
+
+
+class DigitsNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def build():
+    """The digits engine + abstract batch (the telemetry-smoke config
+    without the Trainer — sklearn-digits shapes, 8x8x1 images, 10 classes).
+    Everything here is abstract lowering; no corpus needs loading."""
+    model = DigitsNet()
+
+    def criterion(logits, batch):
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"loss": loss}
+
+    engine = TrainEngine(
+        make_supervised_loss(model, criterion),
+        optax.sgd(0.1, momentum=0.9),
+        mesh_lib.create_mesh(),
+    )
+    state = engine.init_state(
+        jax.random.key(0),
+        lambda rng: model.init(rng, np.zeros((1, 8, 8, 1), np.float32)),
+    )
+    batch = {
+        "image": jax.ShapeDtypeStruct((BATCH, 8, 8, 1), np.float32),
+        "label": jax.ShapeDtypeStruct((BATCH,), np.int32),
+    }
+    return engine, state, batch
+
+
+def independent_peak(engine, state, batch, chain_length=None) -> int:
+    """Re-derive the predicted peak straight from the compiled probe's
+    ``memory_analysis()`` with stdlib arithmetic — no memory/ code."""
+    probe_batch = (
+        stack_chain_batch(batch, chain_length) if chain_length else batch
+    )
+    stats = engine.compile_step_probe(
+        state, probe_batch, donate=True, chain_length=chain_length
+    ).memory_analysis()
+    return int(
+        stats.argument_size_in_bytes
+        + stats.output_size_in_bytes
+        - stats.alias_size_in_bytes
+        + stats.temp_size_in_bytes
+        + stats.generated_code_size_in_bytes
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--inject-oversize",
+        action="store_true",
+        help="self-test: an unfittable capacity MUST fail preflight with a "
+        "finite batch recommendation",
+    )
+    args = parser.parse_args()
+
+    engine, state, batch = build()
+    errors = []
+
+    if args.inject_oversize:
+        # The ONE batch-granularity rule (preflight's own): duplicating it
+        # here would let the seam and the bisection floor silently diverge
+        # on meshes with an fsdp extent.
+        from distributed_training_pytorch_tpu.memory.preflight import _batch_shard
+
+        shard = _batch_shard(engine.mesh)
+        floor_peak = analyze_step_memory(
+            engine,
+            state,
+            jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct((shard,) + l.shape[1:], l.dtype),
+                batch,
+            ),
+            top_k=0,
+        ).peak_bytes
+        full_peak = analyze_step_memory(engine, state, batch, top_k=0).peak_bytes
+        if not floor_peak < full_peak:
+            print(
+                f"MEMORY PROBE: cannot build oversize seam — peak at batch "
+                f"{shard} ({floor_peak}) not below peak at {BATCH} ({full_peak})",
+                file=sys.stderr,
+            )
+            return 1
+        # headroom=0 so the capacity seam IS the usable boundary
+        config = Preflight(
+            capacity_bytes=(floor_peak + full_peak) // 2, headroom=0.0
+        )
+        try:
+            run_preflight(engine, state, batch, config)
+        except PreflightOOMError as e:
+            report = e.report
+            if report.recommended_batch is None:
+                errors.append("oversize preflight failed WITHOUT a batch recommendation")
+            else:
+                rec_batch = jax.tree.map(
+                    lambda l: jax.ShapeDtypeStruct(
+                        (report.recommended_batch,) + l.shape[1:], l.dtype
+                    ),
+                    batch,
+                )
+                rec_peak = analyze_step_memory(engine, state, rec_batch, top_k=0).peak_bytes
+                if rec_peak > report.usable_bytes:
+                    errors.append(
+                        f"recommended batch {report.recommended_batch} does NOT "
+                        f"fit: peak {rec_peak} > usable {report.usable_bytes}"
+                    )
+                print(
+                    f"memory probe self-test OK: oversized config failed "
+                    f"preflight with recommended batch "
+                    f"{report.recommended_batch} (peak {rec_peak} <= usable "
+                    f"{report.usable_bytes}, {report.trials} trials)"
+                )
+        else:
+            errors.append(
+                "oversize preflight PASSED — the gate has no teeth "
+                f"(capacity {config.capacity_bytes} < predicted {full_peak})"
+            )
+        if errors:
+            print("MEMORY PROBE SELF-TEST FAILED:", file=sys.stderr)
+            for err in errors:
+                print(f"  - {err}", file=sys.stderr)
+            return 1
+        return 0
+
+    # -- clean pass: prediction parity + exhaustive attribution ------------
+    for chain_length in (None, CHAIN):
+        label = "chained" if chain_length else "single-step"
+        report = run_preflight(
+            engine,
+            state,
+            batch,
+            # capacity pinned huge: this is the parity check, not a fit test
+            # (CPU reports no real capacity anyway)
+            Preflight(capacity_bytes=1 << 62),
+            chain_length=chain_length,
+        )
+        direct = independent_peak(engine, state, batch, chain_length)
+        if report.predicted_peak_bytes != direct:
+            errors.append(
+                f"{label}: preflight predicted {report.predicted_peak_bytes} "
+                f"!= memory_analysis-derived {direct}"
+            )
+        fractions = report.profile.fractions()
+        total = sum(fractions.values())
+        if abs(total - 1.0) > 1e-6:
+            errors.append(f"{label}: class fractions sum to {total!r}: {fractions}")
+        negative = {c: v for c, v in report.profile.bytes_by_class.items() if v < 0}
+        if negative:
+            errors.append(f"{label}: negative class bytes {negative}")
+        if set(report.profile.bytes_by_class) != set(BUFFER_CLASSES):
+            errors.append(f"{label}: class set drifted: {report.profile.bytes_by_class}")
+        if not report.profile.top_buffers:
+            errors.append(f"{label}: empty largest-buffers table")
+        if report.fits is not True:
+            errors.append(f"{label}: huge capacity did not fit?! {report.fits}")
+        if not errors:
+            biggest = report.profile.top_buffers[0]
+            print(
+                f"memory probe {label}: predicted peak "
+                f"{report.predicted_peak_bytes} B == memory_analysis exactly; "
+                f"fractions sum to 1; top buffer {biggest['dtype']}"
+                f"{biggest['shape']} {biggest['bytes']} B ({biggest['op']})"
+            )
+
+    if errors:
+        print("MEMORY PROBE FAILED:", file=sys.stderr)
+        for err in errors:
+            print(f"  - {err}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

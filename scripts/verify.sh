@@ -34,26 +34,34 @@
 # complete, its StepProfile category fractions must sum to 1 +- eps, and the
 # profile_capture event must land in the log.
 #
-# Stage 6 is the chaos soak in --quick mode: a real digits training job killed
+# Stage 6 is the memory-accounting gate (docs/memory.md): the preflight's
+# predicted peak must equal the number re-derived from
+# compiled.memory_analysis() by independent stdlib arithmetic on the real
+# digits single-step AND chained programs, with buffer-class fractions
+# summing to 1 — and its --inject-oversize self-test: a deliberately
+# unfittable capacity MUST fail preflight with a finite, actually-fitting
+# batch recommendation (the perf-gate "gate has teeth" pattern).
+#
+# Stage 7 is the chaos soak in --quick mode: a real digits training job killed
 # 3 times (graceful SIGTERM, SIGKILL mid-background-commit, SIGKILL mid-
 # chained-window) at seeded offsets, resumed after each kill, asserting every
 # kill leaves >= 1 valid checkpoint, the final params are bit-exact with an
 # uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
 # save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
 #
-# Stage 7 is the perf-regression gate (docs/profiling.md): a ~10s CPU
+# Stage 8 is the perf-regression gate (docs/profiling.md): a ~10s CPU
 # measurement of the real chained-engine path, gated as a machine-portable
 # calibrated ratio against the committed PERF_BASELINE.json — a step-time
 # regression past tolerance (an accidental retrace, a lost chained dispatch
 # path) fails here. The gate's own teeth are tested on every run: a
 # deliberate 3x injected slowdown must make it FAIL.
 #
-# Stage 8 is the ROADMAP.md tier-1 command verbatim.
+# Stage 9 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/8: import health (pytest --collect-only) =="
+echo "== stage 1/9: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -62,7 +70,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/8: static audit (generic + jaxlint + HLO) =="
+echo "== stage 2/9: static audit (generic + jaxlint + HLO) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md)"
@@ -80,43 +88,53 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation hlo \
 fi
 echo "static_audit self-tests OK: injected lint + donation violations correctly failed"
 
-echo "== stage 3/8: chained-dispatch retrace guard =="
+echo "== stage 3/9: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/8: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/9: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/8: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/9: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/8: chaos soak (kill/resume, async checkpointing) =="
-if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
-  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+echo "== stage 6/9: memory-accounting gate (preflight parity + oversize self-test) =="
+if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
+  echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
+  exit 7
+fi
+if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
+  echo "MEMORY PROBE SELF-TEST FAILED — an unfittable config must fail preflight with a batch recommendation"
   exit 7
 fi
 
-echo "== stage 7/8: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 7/9: chaos soak (kill/resume, async checkpointing) =="
+if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
+  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+  exit 8
+fi
+
+echo "== stage 8/9: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
-  exit 8
+  exit 9
 fi
 if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; then
   echo "PERF GATE SELF-TEST FAILED — a 3x injected regression PASSED the gate"
-  exit 8
+  exit 9
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 8/8: tier-1 test suite =="
+echo "== stage 9/9: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

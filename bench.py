@@ -169,6 +169,27 @@ def _metric_name(cfg, image_size, dtype_name):
     )
 
 
+# BENCH_MESH (ISSUE 10 satellite): mesh layout of the benched step — a
+# spec like "dp8" / "fsdp4x2" / "tp2x4" / "dp2fsdp2tp2" (grammar:
+# parallel.mesh.mesh_config_from_spec; docs/parallelism.md), or a comma
+# list for a sweep that prints ONE json line per mesh with `mesh`,
+# `mesh_axes`, `batch_replicas`, `per_chip_param_bytes`, and the
+# per-replica throughput fields alongside the usual per-chip headline —
+# the MULTICHIP_r evidence that fsdp/tensor meshes actually shrink
+# per-chip HBM and scale out. Unset reproduces the historical 1-D data
+# mesh exactly. A tensor>1 mesh applies parallel.transformer_tp_rules
+# (conv models match none of its patterns and take the FSDP fallback).
+def _bench_mesh(mesh_spec):
+    """Build (and validate) the mesh for a BENCH_MESH value. None = the
+    historical default data mesh."""
+    if mesh_spec is None:
+        return mesh_lib.create_mesh()
+    try:
+        return mesh_lib.mesh_config_from_spec(mesh_spec).build()
+    except ValueError as e:
+        raise SystemExit(f"BENCH_MESH: {e}") from e
+
+
 def _bench_memory(compiled, include_peak=True, predicted=None):
     """Per-step device memory: live/peak bytes from the PJRT allocator where
     the backend exposes them (``memory.live.live_memory_fields`` — the ONE
@@ -363,7 +384,8 @@ for _name, _cfg in BENCH_MODELS.items():
     )
 
 
-def build_bench_setup(model_name: str | None = None, dtype_name: str | None = None):
+def build_bench_setup(model_name: str | None = None, dtype_name: str | None = None,
+                      mesh_spec: str | None = None):
     """One source of truth for the executable a ``BENCH_MODEL`` names: build
     the registry model + engine + AOT state + sharded batch + per-model
     compiler options from the same env knobs ``main()`` honors. Used by
@@ -371,7 +393,9 @@ def build_bench_setup(model_name: str | None = None, dtype_name: str | None = No
     timed one.
 
     ``dtype_name`` is ONE ``BENCH_DTYPE`` value (callers handle the sweep);
-    None = the historical program (bf16 model casts, no engine policy)."""
+    None = the historical program (bf16 model casts, no engine policy).
+    ``mesh_spec`` is ONE ``BENCH_MESH`` value; None = the historical 1-D
+    data mesh with replicated state."""
     model_name = model_name or os.environ.get("BENCH_MODEL", "vgg16")
     if model_name not in BENCH_MODELS:
         raise SystemExit(
@@ -383,7 +407,24 @@ def build_bench_setup(model_name: str | None = None, dtype_name: str | None = No
     # Resolved ONCE here; every consumer (engine, main, run_e2e_records)
     # takes it from the returned dict so the knob cannot drift.
     accum_steps = int(os.environ.get("BENCH_ACCUM", str(cfg.get("accum_steps", 1))))
-    mesh = mesh_lib.create_mesh()
+    mesh = _bench_mesh(mesh_spec)
+    replicas = mesh_lib.batch_shard_extent(mesh)
+    if batch % replicas:
+        knob = (
+            f"BENCH_MESH {mesh_spec!r}"
+            if mesh_spec is not None
+            else f"BENCH_BATCH on the default {replicas}-way data mesh"
+        )
+        raise SystemExit(
+            f"{knob}: batch {batch} is not divisible by the mesh's "
+            f"batch-shard extent {replicas} (data x fsdp) — round "
+            "BENCH_BATCH or re-plan the mesh"
+        )
+    # ONE rule-resolution policy with the Trainer (parallel.sharding.
+    # default_sharding_rules): the benched program is the trained one.
+    from distributed_training_pytorch_tpu.parallel import default_sharding_rules
+
+    sharding_rules = default_sharding_rules(mesh)
     model = cfg["build"](cfg["num_classes"], image_size, _bench_dtype(dtype_name))
     loss_scale = None
     if dtype_name == "fp16":
@@ -397,6 +438,7 @@ def build_bench_setup(model_name: str | None = None, dtype_name: str | None = No
         accum_steps=accum_steps,
         precision=dtype_name,  # None -> inactive fp32 policy (historical)
         loss_scale=loss_scale,
+        sharding_rules=sharding_rules,
     )
     state = engine.init_state(
         jax.random.key(0),
@@ -417,6 +459,8 @@ def build_bench_setup(model_name: str | None = None, dtype_name: str | None = No
         "gbatch": gbatch,
         "accum_steps": accum_steps,
         "dtype_name": dtype_name,
+        "mesh_spec": mesh_spec,
+        "mesh": mesh,
         "compiler_options": cfg["compiler_options"]() or None,
     }
 
@@ -572,7 +616,8 @@ def _time_windows(run_once, state, steps, windows, reduce, meter=None):
     return state, dt
 
 
-def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=None):
+def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=None,
+               mesh_spec: str | None = None):
     """One full measurement -> one JSON line. ``ctx`` (a dict) is filled with
     the entry's identity and predicted peak as soon as they are known, so the
     sweep loop's OOM net (``main``) can emit a structured line for an entry
@@ -585,13 +630,15 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
     # config's wall clock went (ConvNeXt-L pays ~10x VGG's compile bill).
     meter = GoodputMeter()
     meter.start()
-    setup = build_bench_setup(dtype_name=dtype_name)
+    setup = build_bench_setup(dtype_name=dtype_name, mesh_spec=mesh_spec)
     meter.tick("other")  # model build + state init + batch staging
     model_name, cfg = setup["model_name"], setup["cfg"]
     batch, image_size = setup["batch"], setup["image_size"]
     if ctx is not None:
         ctx["metric"] = _metric_name(cfg, image_size, dtype_name)
         ctx["batch"] = batch
+        if mesh_spec is not None:
+            ctx["mesh"] = mesh_spec
     model, engine, state, gbatch = (
         setup["model"], setup["engine"], setup["state"], setup["gbatch"]
     )
@@ -930,6 +977,28 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
     items = batch * cfg["items_per_row"](image_size)
     images_per_sec = items / dt
     peak = peak_flops(jax.devices()[0]) * n_chips
+    # BENCH_MESH entry fields: the mesh's identity, the measured per-chip
+    # param residency (the ZeRO-3 HBM win — shard bytes, not global), and
+    # per-replica throughput (telemetry.mfu.throughput_fields: dividing a
+    # TP mesh's throughput by raw chip count would misread cooperation as
+    # slowdown). predicted_peak_bytes already lands via _bench_memory.
+    mesh_fields = {}
+    if setup["mesh_spec"] is not None:
+        from distributed_training_pytorch_tpu.parallel.sharding import (
+            tree_shard_bytes,
+        )
+
+        mesh_fields = {
+            "mesh": setup["mesh_spec"],
+            "mesh_axes": {str(k): int(v) for k, v in setup["mesh"].shape.items()},
+            "per_chip_param_bytes": int(tree_shard_bytes(state.params)),
+            **{
+                k: round(v, 2) if isinstance(v, float) else v
+                for k, v in mfu_lib.throughput_fields(
+                    images_per_sec, setup["mesh"]
+                ).items()
+            },
+        }
     # Three FLOP conventions, all reported (r3 VERDICT item 4 itemization):
     #   mfu      — nominal layer-formula count: the work an eager executor
     #              (the torch reference) performs for this model. Headline,
@@ -1012,6 +1081,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
                 # Compute dtype of the benched step: explicit BENCH_DTYPE, or
                 # the historical model-internal-bf16 program when unset.
                 "dtype": setup["dtype_name"] or "bf16",
+                **mesh_fields,
                 **memory,
                 **(
                     {"arith_intensity": round(arith_intensity, 2)}
@@ -1039,8 +1109,23 @@ def main():
     sweep = [d.strip() for d in os.environ.get("BENCH_DTYPE", "").split(",") if d.strip()]
     for dtype_name in sweep:
         _bench_dtype(dtype_name)
+    # BENCH_MESH sweep (ISSUE 10): one json line per mesh layout; composes
+    # with the dtype sweep as an outer product (meshes outermost, so a
+    # MULTICHIP_r mesh sweep groups each mesh's dtype lines together).
+    # Validated up front like the dtype list — a typo'd last mesh must fail
+    # in milliseconds, not after the earlier meshes' measurements.
+    mesh_sweep = [
+        m.strip() for m in os.environ.get("BENCH_MESH", "").split(",") if m.strip()
+    ]
+    for spec in mesh_sweep:
+        _bench_mesh(spec)
+    entries = [
+        (mesh_spec, dtype_name)
+        for mesh_spec in (mesh_sweep or [None])
+        for dtype_name in (sweep or [None])
+    ]
     failed = False
-    for i, dtype_name in enumerate(sweep or [None]):
+    for i, (mesh_spec, dtype_name) in enumerate(entries):
         # peak_bytes only on the first run of the process: the allocator's
         # peak is a lifetime high-water mark (see _bench_memory).
         #
@@ -1052,7 +1137,7 @@ def main():
         # that is not an OOM is a bug, not a fit boundary.
         ctx = {}
         try:
-            _run_bench(dtype_name, include_peak=(i == 0), ctx=ctx)
+            _run_bench(dtype_name, include_peak=(i == 0), ctx=ctx, mesh_spec=mesh_spec)
         except Exception as e:  # noqa: BLE001 — classified below, re-raised if not OOM
             if not memory_lib.is_oom_error(e):
                 raise
@@ -1064,6 +1149,7 @@ def main():
                             "metric", os.environ.get("BENCH_MODEL", "vgg16")
                         ),
                         "dtype": dtype_name or "bf16",
+                        **({"mesh": mesh_spec} if mesh_spec else {}),
                         "oom": True,
                         **(
                             {"batch": ctx["batch"]} if "batch" in ctx else {}

@@ -302,11 +302,16 @@ def audit_host_callbacks(hlo_text: str, *, label: str = "") -> CallbackReport:
 # -- the audited workload ---------------------------------------------------
 
 
-def build_audit_engine(precision=None, mesh=None):
+def build_audit_engine(precision=None, mesh=None, *, sharding_rules=None,
+                       fsdp_min_size: int = 2**18):
     """A small conv+dense workload through the real :class:`TrainEngine` —
     the same shape of fixture the perf gate times (CPU-viable, compiles in
     seconds), here only *lowered*, never run. Returns ``(engine,
-    abstract_state, abstract_batch)``; nothing touches a device."""
+    abstract_state, abstract_batch)``; nothing touches a device.
+    ``sharding_rules``/``fsdp_min_size`` configure the sharded-audit
+    variants (a low ``fsdp_min_size`` so the fixture's small leaves really
+    shard — a "sharded" audit of a fully replicated program would be a
+    vacuous pass)."""
     import optax
     from flax import linen as nn
 
@@ -336,6 +341,8 @@ def build_audit_engine(precision=None, mesh=None):
         optimizer,
         mesh if mesh is not None else mesh_lib.create_mesh(),
         precision=precision,
+        sharding_rules=sharding_rules,
+        fsdp_min_size=fsdp_min_size,
     )
     batch_size = 8 * max(1, jax.device_count())
 
@@ -371,45 +378,105 @@ class HloAuditReport:
     chained: DonationReport
     precision: PrecisionReport
     callbacks: CallbackReport
+    # SPMD-partitioned twins (ISSUE 10): the same invariants on programs
+    # whose state is REALLY fsdp/tensor-sharded. None = skipped (fewer than
+    # 8 devices — the forced-host count scripts/static_audit.py sets up);
+    # the `sharded` flag distinguishes "ran and passed" from "not run".
+    sharded_single: "DonationReport | None" = None
+    sharded_chained: "DonationReport | None" = None
+    sharded_precision: "PrecisionReport | None" = None
     injected: bool = False
 
     @property
+    def sharded(self) -> bool:
+        return self.sharded_single is not None
+
+    def _parts(self):
+        parts = [self.single, self.chained, self.precision, self.callbacks]
+        parts += [
+            p
+            for p in (self.sharded_single, self.sharded_chained, self.sharded_precision)
+            if p is not None
+        ]
+        return parts
+
+    @property
     def ok(self) -> bool:
-        return (
-            self.single.ok and self.chained.ok
-            and self.precision.ok and self.callbacks.ok
-        )
+        return all(part.ok for part in self._parts())
 
     def describe(self) -> str:
-        return "\n".join(
-            "  " + part.describe()
-            for part in (self.single, self.chained, self.precision, self.callbacks)
-        )
+        lines = ["  " + part.describe() for part in self._parts()]
+        if not self.sharded:
+            lines.append(
+                "  sharded audit: SKIPPED (needs >= 8 devices for the "
+                "data=2/fsdp=2/tensor=2 mesh; static_audit forces 8 host "
+                "devices, so the verify gate always runs it)"
+            )
+        return "\n".join(lines)
 
     def to_fields(self) -> dict:
         """Flat JSON-safe summary for the ``static_audit`` telemetry event."""
-        return {
+        fields = {
             "undonated_bytes_single": self.single.undonated_bytes,
             "undonated_bytes_chained": self.chained.undonated_bytes,
             "donated_fraction_single": self.single.donated_fraction,
             "donated_fraction_chained": self.chained.donated_fraction,
             "precision_leaks": len(self.precision.leaks),
             "host_callbacks": len(self.callbacks.hits),
+            "sharded": self.sharded,
             "injected": self.injected,
             "passed": self.ok,
         }
+        if self.sharded:
+            fields["donated_fraction_sharded_single"] = (
+                self.sharded_single.donated_fraction
+            )
+            fields["donated_fraction_sharded_chained"] = (
+                self.sharded_chained.donated_fraction
+            )
+            fields["sharded_precision_leaks"] = len(self.sharded_precision.leaks)
+        return fields
+
+
+def _audit_mesh():
+    """The sharded-audit mesh: data=2/fsdp=2/tensor=2 over the first 8
+    devices — every sharding mode the Trainer hot path supports, in one
+    program. None when the platform has fewer than 8 devices (the audit is
+    then skipped and says so; ``scripts/static_audit.py`` forces an 8-device
+    host platform so the verify gate always exercises it)."""
+    if jax.device_count() < 8:
+        return None
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib.create_mesh(
+        {"data": 2, "fsdp": 2, "tensor": 2}, devices=jax.devices()[:8]
+    )
+
+
+# Explicit TP rule for the audit fixture's Dense head + a low FSDP cutoff:
+# the fixture's leaves are tiny, and a "sharded" audit of a program whose
+# every leaf fell back to replicated would pass vacuously. test_analysis
+# pins that the audited state really carries fsdp AND tensor specs.
+_AUDIT_SHARDING_RULES = (("Dense_0.*kernel", jax.sharding.PartitionSpec(None, "tensor")),)
+_AUDIT_FSDP_MIN_SIZE = 128
 
 
 def run_hlo_audit(chain_steps: int = 4, *, inject_violation: bool = False) -> HloAuditReport:
     """Lower the real single-step and chained train programs on abstract
     avals (via ``TrainEngine.compile_step_probe``) and audit donation, then
     audit a bf16-policy lowering for precision leaks and the chained
-    program for host callbacks.
+    program for host callbacks. With >= 8 devices the same donation +
+    precision invariants are audited on SPMD-partitioned twins — a
+    data=2/fsdp=2/tensor=2 mesh with genuinely sharded state — because
+    donation under partitioning is a separate property (aliasing must
+    survive SPMD's parameter rewriting) and ISSUE 10's sharded hot path
+    depends on it.
 
     ``inject_violation=True`` is the self-test seam (the perf gate's
-    ``--inject-slowdown`` analog): the donation audits run against probes
-    lowered WITHOUT donation — structurally the exact bug the audit exists
-    to catch — and the report must come back failing.
+    ``--inject-slowdown`` analog): the donation audits — sharded ones
+    included — run against probes lowered WITHOUT donation, structurally
+    the exact bug the audit exists to catch, and the report must come back
+    failing.
     """
     donate = not inject_violation
     engine, state, batch = build_audit_engine()
@@ -428,10 +495,45 @@ def run_hlo_audit(chain_steps: int = 4, *, inject_violation: bool = False) -> Hl
     bf16_engine, bf16_state, bf16_batch = build_audit_engine(precision="bf16")
     lowered = bf16_engine.lower_step_probe(bf16_state, bf16_batch, donate=donate)
     precision_report = audit_precision_leaks(lowered.as_text(), policy="bf16")
+    sharded_single = sharded_chained = sharded_precision = None
+    mesh = _audit_mesh()
+    if mesh is not None:
+        sh_engine, sh_state, sh_batch = build_audit_engine(
+            mesh=mesh,
+            sharding_rules=_AUDIT_SHARDING_RULES,
+            fsdp_min_size=_AUDIT_FSDP_MIN_SIZE,
+        )
+        sh_compiled = sh_engine.compile_step_probe(sh_state, sh_batch, donate=donate)
+        sharded_single = audit_donation(
+            sh_compiled, (sh_state, sh_batch), label="sharded single-step"
+        )
+        sh_window = _stack_abstract(sh_batch, chain_steps)
+        sh_chained = sh_engine.compile_step_probe(
+            sh_state, sh_window, donate=donate, chain_length=chain_steps
+        )
+        sharded_chained = audit_donation(
+            sh_chained, (sh_state, sh_window),
+            label=f"sharded chained x{chain_steps}",
+        )
+        sh_bf16_engine, sh_bf16_state, sh_bf16_batch = build_audit_engine(
+            precision="bf16",
+            mesh=mesh,
+            sharding_rules=_AUDIT_SHARDING_RULES,
+            fsdp_min_size=_AUDIT_FSDP_MIN_SIZE,
+        )
+        sh_lowered = sh_bf16_engine.lower_step_probe(
+            sh_bf16_state, sh_bf16_batch, donate=donate
+        )
+        sharded_precision = audit_precision_leaks(
+            sh_lowered.as_text(), policy="bf16 sharded"
+        )
     return HloAuditReport(
         single=single_report,
         chained=chained_report,
         precision=precision_report,
         callbacks=callback_report,
+        sharded_single=sharded_single,
+        sharded_chained=sharded_chained,
+        sharded_precision=sharded_precision,
         injected=inject_violation,
     )

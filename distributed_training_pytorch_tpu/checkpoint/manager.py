@@ -220,6 +220,7 @@ class CheckpointManager:
         metrics: Mapping | None = None,
         loop_state: Mapping | None = None,
         telemetry: Mapping | None = None,
+        sharding: Mapping | None = None,
     ) -> None:
         """Collective save of ``state`` + meta under ``directory/name``.
 
@@ -236,6 +237,18 @@ class CheckpointManager:
         trainer's goodput buckets, ``telemetry/goodput.py``) into the meta
         json the same way — json round-trips Python floats exactly, so a
         resumed run's counters are bit-identical to the saved ones.
+
+        ``sharding`` is the state's sharding-metadata record
+        (``parallel.sharding.sharding_record``: mesh axis sizes + the
+        PartitionSpec of every sharded leaf). When None it is derived from
+        ``state``'s live leaves — callers whose state was already
+        snapshotted to host numpy (the async saver) pass the record they
+        captured from the live arrays, because ``device_get`` strips
+        shardings. Orbax writes the GLOBAL array either way (every process
+        contributes its addressable shards); the record documents the
+        layout the run trained in, and lets a restore into a different mesh
+        be detected and logged as a resharding restore
+        (docs/parallelism.md).
         """
         self.wait()  # a name may be overwritten; finish any in-flight save first
         self._gc_periodic()  # previous save is committed; safe to prune now
@@ -254,6 +267,14 @@ class CheckpointManager:
             meta["loop"] = {k: int(v) for k, v in loop_state.items()}
         if telemetry is not None:
             meta["telemetry"] = dict(telemetry)
+        if sharding is None:
+            from distributed_training_pytorch_tpu.parallel.sharding import (
+                sharding_record,
+            )
+
+            sharding = sharding_record(state)
+        if sharding is not None:
+            meta["sharding"] = dict(sharding)
         # Typed PRNG keys carry an extended dtype serializers reject; store
         # the raw key words + impl name and rebuild on restore (this is also
         # what makes params_only restores work across PRNG impls — key
@@ -545,7 +566,15 @@ class CheckpointManager:
         if validate and not has_manifest and not legacy:
             # current-format checkpoint with its manifest gone: torn commit
             self.validate(path)  # raises the canonical no-manifest error
+        # to_shape_dtype_struct preserves each live leaf's NamedSharding, so
+        # the restore target's layout — replicated for DP, fsdp/tensor
+        # shards otherwise — drives where orbax lays the bytes. That is what
+        # makes restore RESHARDING-CAPABLE: a checkpoint written under one
+        # mesh restores into any other (DP <-> FSDP both directions,
+        # test-enforced) because orbax reads the stored global array and
+        # places the target's shards, whatever the writer's layout was.
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target_state)
+        self._note_reshard(name_or_path, pre_meta, target_state)
         items = {
             "params": ocp.args.StandardRestore(abstract.params),
             "meta": ocp.args.JsonRestore(),
@@ -556,17 +585,30 @@ class CheckpointManager:
             # different key layout than the current target would impose.
             items["rest"] = ocp.args.StandardRestore()
         else:
+            # rng is stored as raw key words; recover their aval from the
+            # target's key (works across impls of the same width; differing
+            # widths restore shape-as-stored below). eval_shape strips the
+            # sharding, so it is re-attached from the target key — without
+            # it orbax falls back to the checkpoint's sharding file, which
+            # is exactly wrong on a resharding restore.
+            rng_data = jax.eval_shape(
+                lambda k: jax.random.key_data(k) if _is_typed_key(k) else k,
+                abstract.rng,
+            )
+            rng_sharding = getattr(target_state.rng, "sharding", None)
+            if isinstance(rng_sharding, jax.sharding.NamedSharding):
+                rng_data = jax.ShapeDtypeStruct(
+                    rng_data.shape,
+                    rng_data.dtype,
+                    sharding=jax.sharding.NamedSharding(
+                        rng_sharding.mesh, jax.sharding.PartitionSpec()
+                    ),
+                )
             items["rest"] = ocp.args.StandardRestore(
                 {
                     "step": abstract.step,
                     "model_state": abstract.model_state,
-                    # rng is stored as raw key words; recover their aval from
-                    # the target's key (works across impls of the same width;
-                    # differing widths restore shape-as-stored below).
-                    "rng_data": jax.eval_shape(
-                        lambda k: jax.random.key_data(k) if _is_typed_key(k) else k,
-                        abstract.rng,
-                    ),
+                    "rng_data": rng_data,
                 }
             )
             items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
@@ -611,6 +653,34 @@ class CheckpointManager:
                 loss_scale=serialization.from_state_dict(target_scale, restored.scale)
             )
         return state, int(meta.get("epoch", 0))
+
+    def _note_reshard(self, name: str, pre_meta: Mapping, target_state: Any) -> None:
+        """Detect a resharding restore — the checkpoint's recorded layout
+        differs from the restore target's — and put it in the flight record
+        (``checkpoint_reshard`` event; docs/observability.md). Detection
+        only: the relayout itself is orbax's restore doing its normal job
+        against the target shardings. A missing stored record means pure-DP
+        / pre-sharding — restoring THAT into a sharded target (or a sharded
+        checkpoint into a DP target) is the DP<->FSDP elasticity path and
+        is still logged."""
+        if self.event_log is None:
+            return
+        from distributed_training_pytorch_tpu.parallel.sharding import (
+            sharding_record,
+        )
+
+        stored = pre_meta.get("sharding")
+        target = sharding_record(target_state)
+        if stored == target:
+            return
+        self.event_log.emit(
+            "checkpoint_reshard",
+            name=os.path.basename(str(name)),
+            from_mesh=(stored or {}).get("mesh"),
+            to_mesh=(target or {}).get("mesh"),
+            from_sharded_leaves=len((stored or {}).get("specs", {})),
+            to_sharded_leaves=len((target or {}).get("specs", {})),
+        )
 
     @staticmethod
     def _restored_rng(rest: Mapping, meta: Mapping, target_rng):

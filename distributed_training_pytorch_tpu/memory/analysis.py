@@ -117,7 +117,19 @@ def _peak_from_stats(stats: Mapping[str, int]) -> int:
     )
 
 
-def _tree_bytes(tree) -> float:
+def _tree_bytes(tree, shardings=None) -> float:
+    """Byte total of a pytree's leaves. ``shardings`` (a single Sharding or
+    a matching tree; see ``parallel.sharding.tree_shard_bytes``) sizes each
+    leaf at its PER-DEVICE shard shape — the convention an SPMD program's
+    ``memory_analysis()`` reports in (measured: a data-sharded batch's
+    argument bytes are batch/extent, an fsdp-sharded param's are
+    param/extent). None = global aval bytes (replicated)."""
+    if shardings is not None:
+        from distributed_training_pytorch_tpu.parallel.sharding import (
+            tree_shard_bytes,
+        )
+
+        return tree_shard_bytes(tree, shardings)
     return float(
         sum(
             aval_bytes(tuple(leaf.shape), getattr(leaf, "dtype", None))
@@ -126,29 +138,40 @@ def _tree_bytes(tree) -> float:
     )
 
 
-def state_class_bytes(state) -> dict[str, float]:
-    """Aval byte totals of a ``TrainState``'s leaves by buffer class:
-    ``params`` (master params + model collections like BN stats) and
+def state_class_bytes(state, shardings=None) -> dict[str, float]:
+    """Byte totals of a ``TrainState``'s leaves by buffer class: ``params``
+    (master params + model collections like BN stats) and
     ``optimizer_state`` (optax state, plus the step/rng/loss-scale
-    bookkeeping leaves — a few dozen bytes riding the bigger class)."""
-    params = _tree_bytes(getattr(state, "params", None)) + _tree_bytes(
-        getattr(state, "model_state", None)
+    bookkeeping leaves — a few dozen bytes riding the bigger class).
+    ``shardings`` (a ``TrainState``-shaped tree of ``NamedSharding``s, e.g.
+    ``TrainEngine.state_sharding_tree``) switches every leaf to PER-DEVICE
+    shard bytes — required for sharded programs, where the global aval sum
+    would overstate an fsdp/tensor-sharded class by its shard extent."""
+    sh = shardings
+    params = _tree_bytes(
+        getattr(state, "params", None), getattr(sh, "params", None)
+    ) + _tree_bytes(
+        getattr(state, "model_state", None), getattr(sh, "model_state", None)
     )
     optimizer = (
-        _tree_bytes(getattr(state, "opt_state", None))
-        + _tree_bytes(getattr(state, "step", None))
-        + _tree_bytes(getattr(state, "rng", None))
-        + _tree_bytes(getattr(state, "loss_scale", None))
+        _tree_bytes(getattr(state, "opt_state", None), getattr(sh, "opt_state", None))
+        + _tree_bytes(getattr(state, "step", None), getattr(sh, "step", None))
+        + _tree_bytes(getattr(state, "rng", None), getattr(sh, "rng", None))
+        + _tree_bytes(
+            getattr(state, "loss_scale", None), getattr(sh, "loss_scale", None)
+        )
     )
     return {"params": params, "optimizer_state": optimizer}
 
 
-def batch_class_bytes(batch) -> float:
-    """Aval byte total of the input batch tree (for a chained program, the
-    whole chain-stacked window — ``chain_steps`` global batches are live in
-    device memory at once, which is exactly why chained windows move the
-    fit boundary)."""
-    return _tree_bytes(batch)
+def batch_class_bytes(batch, sharding=None) -> float:
+    """Byte total of the input batch tree (for a chained program, the whole
+    chain-stacked window — ``chain_steps`` global batches are live in device
+    memory at once, which is exactly why chained windows move the fit
+    boundary). ``sharding`` (the engine's batch / chain-batch
+    ``NamedSharding``) sizes the PER-DEVICE shard: the batch dim splits over
+    data x fsdp, so each device stages only its own rows."""
+    return _tree_bytes(batch, sharding)
 
 
 # One optimized-HLO definition line: `%name = dtype[dims]{layout} opcode(`.
@@ -316,9 +339,29 @@ def analyze_step_memory(
             "backend reports no memory analysis for the compiled step — "
             "memory attribution unavailable on this platform"
         )
-    input_classes = dict(state_class_bytes(state))
-    input_classes["input_batch"] = batch_class_bytes(probe_batch)
-    grad_bytes = _tree_bytes(getattr(state, "params", None))
+    # Per-DEVICE input bytes, sized by the engine's own layouts: an SPMD
+    # program's memory_analysis() reports the per-device module (an
+    # fsdp-sharded param contributes bytes/extent, a data-sharded batch
+    # rows/extent), so the classable input sum must use shard shapes or the
+    # pro-rata partition would skew toward whichever class shards least.
+    # On a pure-DP mesh the state tree is replicated (shard == global) and
+    # only the batch shrinks — which is also what XLA reports.
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+    state_sh = engine.state_sharding_tree(state)
+    batch_sh = (
+        mesh_lib.chain_batch_sharding(engine.mesh)
+        if chain_length
+        else mesh_lib.batch_sharding(engine.mesh)
+    )
+    input_classes = dict(state_class_bytes(state, state_sh))
+    input_classes["input_batch"] = batch_class_bytes(probe_batch, batch_sh)
+    # The grad tree mirrors the params; under fsdp sharding XLA's
+    # reduce-scatter keeps per-device grad residency at the shard size, so
+    # the gradients-class cap is the per-device param bytes.
+    grad_bytes = _tree_bytes(
+        getattr(state, "params", None), getattr(state_sh, "params", None)
+    )
     top = (
         top_buffers_from_hlo(compiled.as_text(), top_k) if top_k > 0 else []
     )

@@ -12,10 +12,18 @@ anything — so the preflight turns it into one structured report:
 * compare against per-device capacity minus a headroom margin
   (fragmentation, collectives scratch, the allocator's own slack);
 * on predicted OOM, **bisect over abstract lowerings** for the largest
-  batch that fits, and probe doubling grad-accumulation factors for the
-  smallest microbatch split that keeps the full batch — then fail fast
-  (``action="raise"``) with both recommendations in the error, before any
+  batch that fits, probe doubling grad-accumulation factors for the
+  smallest microbatch split that keeps the full batch, and (on a pure
+  batch-parallel mesh) probe ``TrainEngine.with_mesh`` twins for the
+  smallest ``fsdp=N`` whose per-device peak fits (ZeRO-3 sharding of
+  params + optimizer state — docs/parallelism.md) — then fail fast
+  (``action="raise"``) with the recommendations in the error, before any
   device ever allocates a byte.
+
+Sharded programs are sized in PER-DEVICE shard bytes end to end: the SPMD
+executable's ``memory_analysis()`` reports the per-device module, and the
+attribution layer (``memory.analysis``) sizes every input leaf at its
+shard shape to match.
 
 ``Trainer(preflight=...)`` wires this in front of the first real compile;
 ``preflight=None`` (the default) reproduces the historical program exactly
@@ -134,6 +142,7 @@ class PreflightReport:
     chain_length: int | None = None
     recommended_batch: int | None = None
     recommended_accum: int | None = None
+    recommended_fsdp: int | None = None
     trials: int = 0
     seconds: float = 0.0
 
@@ -147,6 +156,7 @@ class PreflightReport:
             "headroom": self.headroom,
             "recommended_batch": self.recommended_batch,
             "recommended_accum": self.recommended_accum,
+            "recommended_fsdp": self.recommended_fsdp,
             "trials": self.trials,
             "seconds": round(self.seconds, 3),
             "top_buffers": self.profile.top_buffers[:5],
@@ -165,11 +175,9 @@ def _leading_dim(batch) -> int:
 def _batch_shard(mesh) -> int:
     """The batch-dim sharding granularity: global batches must be multiples
     of the mesh extent over the batch axes (``parallel.mesh.batch_sharding``
-    shards dim 0 over data x fsdp)."""
-    shard = 1
-    for axis in (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS):
-        shard *= int(mesh.shape.get(axis, 1))
-    return max(1, shard)
+    shards dim 0 over data x fsdp) — the ONE definition, shared with the
+    Trainer's ctor divisibility check."""
+    return mesh_lib.batch_shard_extent(mesh)
 
 
 def _resize_batch(batch, new_leading: int):
@@ -321,6 +329,38 @@ def _recommend(engine, state, batch, config, report, chain_length) -> None:
             report.recommended_accum = accum
             break
         factor *= 2
+    # -- smallest fsdp extent that fits (ZeRO-3: shard params + opt state)
+    # Probed on with_mesh twins that split the current data axis into
+    # data x fsdp: the batch-shard extent (data x fsdp product) is
+    # unchanged, so the same global batch divides, and per-device peak
+    # falls as param/optimizer shards shrink. Only attempted on a pure
+    # batch-parallel mesh (re-planning a tensor/pipe/expert mesh is an
+    # operator decision, not a preflight guess).
+    mesh = engine.mesh
+    data = int(mesh.shape.get(mesh_lib.DATA_AXIS, 1))
+    replanable = data > 1 and all(
+        int(extent) == 1
+        for axis, extent in mesh.shape.items()
+        if axis != mesh_lib.DATA_AXIS
+    )
+    if replanable:
+        # Every divisor of the data extent is a legal fsdp split (doubling
+        # would dead-end at the first non-dividing power of two — data=12
+        # can shard 2/3/4/6/12-ways, not just 2 and 4). Smallest first:
+        # the least-disruptive mesh change that fits wins.
+        for fsdp in sorted(
+            f for f in range(2, data + 1) if data % f == 0
+        ):
+            if report.trials >= config.max_trials:
+                break
+            trial_mesh = mesh_lib.create_mesh(
+                {mesh_lib.DATA_AXIS: data // fsdp, mesh_lib.FSDP_AXIS: fsdp},
+                devices=list(mesh.devices.flat),
+            )
+            twin = engine.with_mesh(trial_mesh)
+            if _predict(twin, state, batch, chain_length, report) <= usable:
+                report.recommended_fsdp = fsdp
+                break
 
 
 def _failure_message(report: PreflightReport) -> str:
@@ -353,9 +393,21 @@ def _failure_message(report: PreflightReport) -> str:
             f"full batch {report.batch_size} (microbatch "
             f"{report.batch_size // report.recommended_accum})"
         )
-    if report.recommended_batch is None and report.recommended_accum is None:
+    if report.recommended_fsdp is not None:
+        lines.append(
+            f"  recommendation: enable fsdp={report.recommended_fsdp} — "
+            f"Trainer(mesh=MeshConfig(fsdp={report.recommended_fsdp}).build()) "
+            "shards params + optimizer state per-device at the same global "
+            "batch (predicted to fit; docs/parallelism.md)"
+        )
+    if (
+        report.recommended_batch is None
+        and report.recommended_accum is None
+        and report.recommended_fsdp is None
+    ):
         lines.append(
             "  no fitting configuration found (params + optimizer state may "
-            "exceed capacity outright — shard the model, ROADMAP item 1)"
+            "exceed capacity outright — shard the model over more chips: "
+            "MeshConfig(fsdp=...), docs/parallelism.md)"
         )
     return "\n".join(lines)

@@ -1,8 +1,12 @@
 from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
     setup_distributed,
     shutdown_distributed,
+    batch_shard_extent,
     create_mesh,
     batch_sharding,
+    mesh_config_from_spec,
+    mesh_from_env,
     replicated_sharding,
     local_batch_size,
     process_index,
@@ -15,9 +19,12 @@ from distributed_training_pytorch_tpu.parallel.ring_attention import (  # noqa: 
     ulysses_attention,
 )
 from distributed_training_pytorch_tpu.parallel.sharding import (  # noqa: F401
+    default_sharding_rules,
+    sharding_record,
     spec_for_leaf,
     state_shardings,
     transformer_tp_rules,
+    tree_shard_bytes,
 )
 from distributed_training_pytorch_tpu.parallel.pipeline import (  # noqa: F401
     PIPE_AXIS,

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 from typing import Mapping, Sequence
 
 import jax
@@ -178,6 +179,80 @@ def chain_batch_sharding(mesh: Mesh, batch_axes: Sequence[str] | None = None) ->
         batch_axes = [a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names]
     spec = P(None, tuple(batch_axes)) if batch_axes else P()
     return NamedSharding(mesh, spec)
+
+
+def batch_shard_extent(mesh: Mesh) -> int:
+    """How many ways the batch dimension is sharded on ``mesh`` — the
+    product of the batch-like axes present (``data`` x ``fsdp``, the axes
+    :func:`batch_sharding` splits dim 0 over). This, NOT ``mesh.devices.
+    size``, is the divisor for global-batch divisibility checks and
+    per-replica throughput math: a ``data=2, tensor=4`` mesh runs 2 batch
+    shards on 8 chips — every ``tensor`` group of 4 devices cooperates on
+    ONE shard."""
+    extent = 1
+    for axis in (DATA_AXIS, FSDP_AXIS):
+        extent *= int(mesh.shape.get(axis, 1))
+    return max(1, extent)
+
+
+# Mesh-spec grammar (the ``MESH``/``BENCH_MESH`` env-knob syntax; see
+# docs/parallelism.md): either concatenated axis-size pairs ("dp2fsdp2tp2"
+# -> data=2, fsdp=2, tensor=2) or the two-axis shorthand "<kind>KxD" where K
+# is the kind's extent and D the data extent ("fsdp4x2" -> fsdp=4, data=2;
+# "tp2x4" -> tensor=2, data=4). "dp8" -> pure 8-way data parallelism.
+_SPEC_KINDS = {
+    "dp": "data",
+    "fsdp": "fsdp",
+    "tp": "tensor",
+    "sp": "seq",
+    "pp": "pipe",
+    "ep": "expert",
+}
+_SPEC_SHORT_RE = re.compile(r"^(fsdp|tp|sp|pp|ep)(\d+)x(\d+)$")
+_SPEC_PAIRS_RE = re.compile(r"(fsdp|dp|tp|sp|pp|ep)(\d+)")
+
+
+def mesh_config_from_spec(spec: str) -> "MeshConfig":
+    """Parse a compact mesh spec string into a :class:`MeshConfig`.
+
+    ``"dp8"`` -> 8-way data; ``"fsdp4x2"`` -> fsdp=4, data=2;
+    ``"tp2x4"`` -> tensor=2, data=4; ``"dp2fsdp2tp2"`` -> data=2, fsdp=2,
+    tensor=2. One grammar shared by the examples' ``MESH`` knob and
+    ``bench.py``'s ``BENCH_MESH`` sweep."""
+    text = spec.strip().lower()
+    if not text:
+        raise ValueError("empty mesh spec")
+    m = _SPEC_SHORT_RE.match(text)
+    if m:
+        kind, extent, data = m.group(1), int(m.group(2)), int(m.group(3))
+        return MeshConfig(**{"data": data, _SPEC_KINDS[kind]: extent})
+    pairs = _SPEC_PAIRS_RE.findall(text)
+    if not pairs or "".join(k + n for k, n in pairs) != text:
+        raise ValueError(
+            f"unparseable mesh spec {spec!r} — use axis-size pairs like "
+            "'dp8', 'dp2fsdp2tp2', or the shorthand 'fsdp4x2' / 'tp2x4' "
+            "(<kind><extent>x<data>)"
+        )
+    axes: dict[str, int] = {}
+    for kind, n in pairs:
+        name = _SPEC_KINDS[kind]
+        if name in axes:
+            raise ValueError(f"mesh spec {spec!r} names axis {name!r} twice")
+        axes[name] = int(n)
+    axes.setdefault("data", 1)
+    return MeshConfig(**axes)
+
+
+def mesh_from_env(var: str = "MESH") -> Mesh | None:
+    """Resolve the examples' ``MESH`` env knob (docs/parallelism.md
+    grammar via :func:`mesh_config_from_spec`) to a built mesh.
+    Unset/empty = None = the historical 1-D data mesh — the one
+    implementation shared by every example entry so the knob's semantics
+    cannot drift between them."""
+    spec = os.environ.get(var)
+    if not spec:
+        return None
+    return mesh_config_from_spec(spec).build()
 
 
 def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:

@@ -128,22 +128,144 @@ def state_shardings(
     return jax.tree_util.tree_map_with_path(leaf_sharding, state)
 
 
+# -- per-device shard accounting -------------------------------------------
+
+
+def shard_shape(global_shape: tuple[int, ...], sharding) -> tuple[int, ...]:
+    """The per-device shard shape a leaf of ``global_shape`` has under
+    ``sharding`` (a :class:`~jax.sharding.NamedSharding`; None or any
+    sharding-less object means replicated — the global shape)."""
+    if sharding is None or not hasattr(sharding, "shard_shape"):
+        return tuple(global_shape)
+    return tuple(sharding.shard_shape(tuple(global_shape)))
+
+
+def expand_shardings(tree: Any, shardings: Any) -> Any:
+    """Broadcast ``shardings`` to match ``tree``'s structure: a single
+    Sharding instance applies to every leaf (the engine's pure-DP
+    ``state_sharding`` is ONE replicated NamedSharding, not a tree);
+    a matching pytree passes through."""
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree.map(lambda _: shardings, tree)
+    return shardings
+
+
+def tree_shard_bytes(tree: Any, shardings: Any = None) -> float:
+    """Per-DEVICE byte total of a pytree: every leaf sized at its shard
+    shape under ``shardings`` (see :func:`expand_shardings`; None = each
+    leaf's own ``.sharding`` when it carries one, else replicated). This —
+    not the global aval sum — is what an SPMD program's per-device
+    ``memory_analysis()`` argument bytes correspond to."""
+    from distributed_training_pytorch_tpu.utils.hlo_flops import aval_bytes
+
+    if shardings is None:
+        leaves = [
+            (tuple(getattr(x, "shape", ()) or ()), getattr(x, "dtype", None),
+             getattr(x, "sharding", None))
+            for x in jax.tree.leaves(tree)
+        ]
+    else:
+        shardings = expand_shardings(tree, shardings)
+        # strict: a shardings tree covering only part of `tree` must error,
+        # not silently truncate into an undercounted byte total (this sum
+        # feeds memory attribution and the preflight OOM verdict).
+        leaves = [
+            (tuple(getattr(x, "shape", ()) or ()), getattr(x, "dtype", None), s)
+            for x, s in zip(
+                jax.tree.leaves(tree),
+                jax.tree.leaves(
+                    shardings,
+                    is_leaf=lambda s: isinstance(s, jax.sharding.Sharding),
+                ),
+                strict=True,
+            )
+        ]
+    return float(
+        sum(aval_bytes(shard_shape(shape, s), dtype) for shape, dtype, s in leaves)
+    )
+
+
+def sharding_record(state: Any, shardings: Any = None) -> dict | None:
+    """Compact JSON-safe description of a state's sharded layout — the
+    checkpoint sharding-metadata record (docs/parallelism.md): the mesh's
+    axis sizes plus the PartitionSpec of every NON-replicated leaf. None
+    when nothing is sharded (a pure-DP / host-snapshot state) — pre-sharding
+    checkpoints and sharded ones are distinguishable by the record's
+    presence. Restore does not NEED the record (the restore target's own
+    shardings drive the relayout); it exists so a checkpoint's layout is
+    inspectable before building a restore target, and so resharding
+    restores can be detected and logged."""
+    if shardings is not None:
+        shardings = expand_shardings(state, shardings)
+        pairs = zip(
+            jax.tree_util.tree_leaves_with_path(state),
+            jax.tree.leaves(shardings,
+                            is_leaf=lambda s: isinstance(s, jax.sharding.Sharding)),
+            strict=True,  # partial shardings tree = caller bug, not "less sharded"
+        )
+        leaves = [(path, s) for (path, _), s in pairs]
+    else:
+        leaves = [
+            (path, getattr(leaf, "sharding", None))
+            for path, leaf in jax.tree_util.tree_leaves_with_path(state)
+        ]
+    mesh_axes: dict[str, int] = {}
+    specs: dict[str, str] = {}
+    for path, s in leaves:
+        if not isinstance(s, NamedSharding):
+            continue
+        mesh_axes = {str(k): int(v) for k, v in s.mesh.shape.items()}
+        if s.spec != P():
+            specs[jax.tree_util.keystr(path)] = str(s.spec)
+    if not mesh_axes or not specs:
+        return None
+    return {"mesh": mesh_axes, "specs": specs}
+
+
 # -- predefined tensor-parallel rule sets ----------------------------------
 
+def default_sharding_rules(mesh: Mesh) -> "list[Rule] | None":
+    """The ONE default rule-resolution policy, shared by
+    ``Trainer(sharding_rules="auto")`` (via its ``build_sharding_rules``
+    hook), ``bench.py``'s BENCH_MESH setup, and the multichip dryrun — so
+    the bench measures the same program the Trainer runs: a mesh with a
+    nontrivial ``tensor`` axis gets :func:`transformer_tp_rules` (conv
+    models match none of its patterns and take the FSDP fallback); any
+    other mesh gets None (pure FSDP / replicated)."""
+    if mesh.shape.get(TENSOR_AXIS, 1) > 1:
+        return transformer_tp_rules()
+    return None
+
+
 def transformer_tp_rules(tensor_axis: str = TENSOR_AXIS) -> list[Rule]:
-    """Megatron-style TP for the ViT/transformer blocks in ``models.vit``:
-    column-parallel qkv + MLP-in (output features sharded), row-parallel
-    attention-out + MLP-out (input features sharded; XLA inserts the
-    all-reduce the row-parallel matmul needs). Biases of column-parallel
-    layers shard on their feature dim."""
+    """Megatron-style TP for the transformer blocks in the model zoo —
+    ``models.vit`` (qkv/out/MlpBlock naming) and ``models.transformer_lm``
+    (qkv/attn_out/mlp_in/mlp_out/embed/lm_head): column-parallel qkv +
+    MLP-in (output features sharded), row-parallel attention-out + MLP-out
+    (input features sharded; XLA inserts the all-reduce the row-parallel
+    matmul needs). Biases of column-parallel layers shard on their feature
+    dim. The LM's embedding table and untied head shard over the vocab dim
+    (Megatron's vocab-parallel embedding; the tied head reuses the embed
+    kernel, so the one rule covers both). Rules that match a leaf but do
+    not divide it fall back to FSDP/replicated with a loud warning
+    (:func:`spec_for_leaf`), so these rules are safe to apply zoo-wide —
+    VGG/ResNet/ConvNeXt simply match nothing and take the FSDP path."""
     return [
-        # qkv DenseGeneral kernel [D, 3, H, d] -> heads sharded.
+        # qkv DenseGeneral kernel [D, 3, H, d] -> heads sharded (ViT + LM).
         (r"qkv.*kernel", P(None, None, tensor_axis, None)),
         (r"qkv.*bias", P(None, tensor_axis, None)),
-        # attention out DenseGeneral kernel [H, d, D] -> heads (input) sharded.
-        (r"\bout\b.*kernel", P(tensor_axis, None, None)),
-        # MLP: first Dense column-parallel, second row-parallel.
+        # attention out DenseGeneral kernel [H, d, D] -> heads (input)
+        # sharded: ViT names it `out`, the LM `attn_out`.
+        (r"(\bout\b|attn_out).*kernel", P(tensor_axis, None, None)),
+        # MLP: first Dense column-parallel, second row-parallel (ViT's
+        # MlpBlock Dense_0/Dense_1, the LM's mlp_in/mlp_out).
         (r"MlpBlock_\d+.*Dense_0.*kernel", P(None, tensor_axis)),
         (r"MlpBlock_\d+.*Dense_0.*bias", P(tensor_axis)),
         (r"MlpBlock_\d+.*Dense_1.*kernel", P(tensor_axis, None)),
+        (r"mlp_in.*kernel", P(None, tensor_axis)),
+        (r"mlp_in.*bias", P(tensor_axis)),
+        (r"mlp_out.*kernel", P(tensor_axis, None)),
+        # LM embedding [V, D] + untied head [D, V]: vocab-parallel.
+        (r"\bembed\b.*embedding", P(tensor_axis, None)),
+        (r"lm_head.*kernel", P(None, tensor_axis)),
     ]

@@ -302,12 +302,23 @@ class AsyncCheckpointSaver:
 
     def _snapshot(self, name, state, epoch, metrics, loop_state, telemetry):
         t0 = time.perf_counter()
+        # The sharding-metadata record must come from the LIVE arrays —
+        # device_get returns plain host numpy, and a record derived from the
+        # snapshot would always be empty, silently dropping the layout from
+        # every async save's meta while sync saves kept it.
+        from distributed_training_pytorch_tpu.parallel.sharding import (
+            sharding_record,
+        )
+
+        sharding = sharding_record(state)
         # device_get: one synchronous D2H copy into fresh host buffers. The
         # copy waits for the state's producing computation (so the snapshot
         # is consistent) but NOT for unrelated in-flight work, and later
         # train steps can donate/overwrite the device buffers freely — the
-        # host copy is decoupled. Typed PRNG keys come back as host-backed
-        # key arrays; the manager's save path already serializes those.
+        # host copy is decoupled. For a SHARDED state each leaf is fetched
+        # through its addressable shards (host-local rows of the global
+        # array); typed PRNG keys come back as host-backed key arrays; the
+        # manager's save path already serializes both.
         host_state = jax.device_get(state)
         req = SaveRequest(
             name,
@@ -317,6 +328,7 @@ class AsyncCheckpointSaver:
                 metrics=metrics,
                 loop_state=loop_state,
                 telemetry=telemetry,
+                sharding=sharding,
             ),
         )
         req.snapshot_s = time.perf_counter() - t0

@@ -13,11 +13,15 @@ verdicts (``perf_gate``: measured vs baseline, tolerance, verdict, emitted
 by ``scripts/perf_gate.py``), static-audit verdicts (``static_audit``:
 per-rule lint counts, waiver counts, undonated param/opt-state bytes of
 the single-step and chained programs, precision leaks, host callbacks,
-emitted by ``scripts/static_audit.py --events``), and memory-preflight
+emitted by ``scripts/static_audit.py --events``), memory-preflight
 verdicts (``memory_preflight``: predicted peak vs capacity, per-class
-attribution, batch/microbatch recommendations, emitted by
-``memory.preflight.run_preflight`` before the first dispatch) — as one
-JSON object per line, machine-readable and append-only.
+attribution, batch/microbatch/fsdp recommendations, emitted by
+``memory.preflight.run_preflight`` before the first dispatch), and
+resharding restores (``checkpoint_reshard``: a checkpoint whose recorded
+sharding layout differs from the restore target's — mesh axes and sharded
+leaf counts on both sides, emitted by ``CheckpointManager.restore``; the
+DP<->FSDP elasticity path of docs/parallelism.md) — as one JSON object
+per line, machine-readable and append-only.
 
 Conventions:
 

@@ -18,6 +18,7 @@ __all__ = [
     "PEAK_FLOPS",
     "device_peak_flops",
     "mfu_value",
+    "throughput_fields",
     "window_report",
 ]
 
@@ -50,6 +51,27 @@ def mfu_value(flops_per_step: float, step_time_s: float, peak_flops: float) -> f
     if not flops_per_step or not step_time_s or not peak_flops:
         return None
     return float(flops_per_step) / float(step_time_s) / float(peak_flops)
+
+
+def throughput_fields(items_per_sec: float, mesh) -> dict:
+    """Per-chip AND per-replica throughput for a mesh run (ISSUE 10).
+
+    On a pure-DP mesh the two divisors agree and per-chip is the whole
+    story. On a sharded mesh they do not: ``data=2, tensor=4`` runs TWO
+    batch replicas on 8 chips, so dividing by ``mesh.devices.size`` alone
+    makes a healthy TP config look 4x slower than DP at identical
+    hardware efficiency. The scale-out figure is per batch REPLICA — the
+    batch-sharded axes product (``parallel.mesh.batch_shard_extent``),
+    data x fsdp, never the raw device count."""
+    from distributed_training_pytorch_tpu.parallel.mesh import batch_shard_extent
+
+    n_devices = int(mesh.devices.size)
+    replicas = batch_shard_extent(mesh)
+    return {
+        "items_per_sec_chip": float(items_per_sec) / max(n_devices, 1),
+        "items_per_sec_replica": float(items_per_sec) / max(replicas, 1),
+        "batch_replicas": replicas,
+    }
 
 
 def window_report(

@@ -193,6 +193,16 @@ class TrainEngine:
             )
         return self._state_sharding
 
+    def state_sharding_tree(self, state_or_abstract) -> Any:
+        """:meth:`state_sharding` expanded to one ``NamedSharding`` per leaf
+        (pure DP returns a SINGLE replicated sharding there — consumers that
+        need per-leaf shard shapes, like the memory subsystem's per-device
+        byte accounting and the checkpoint sharding record, want the
+        broadcast tree)."""
+        return sharding_lib.expand_shardings(
+            state_or_abstract, self.state_sharding(state_or_abstract)
+        )
+
     def _build_steps(self, state) -> None:
         if self._train_step is not None:
             return
@@ -622,6 +632,30 @@ class TrainEngine:
             self.optimizer,
             self.mesh,
             accum_steps=accum_steps,
+            schedule=self.schedule,
+            donate_state=bool(self._donate),
+            sharding_rules=self.sharding_rules,
+            fsdp_min_size=self.fsdp_min_size,
+            nan_guard=self.nan_guard,
+            precision=self.precision,
+            loss_scale=self.initial_loss_scale,
+            stats=self.stats,
+        )
+
+    def with_mesh(self, mesh: Mesh) -> "TrainEngine":
+        """An observability twin of this engine on a DIFFERENT mesh — same
+        loss fn, optimizer, precision, guard, donation, sharding rules, and
+        accumulation, fresh jit caches and a fresh state-sharding layout.
+        ``memory.preflight`` probes these (abstract lowerings only, never
+        dispatched) to answer "would this program fit with fsdp=N" — the
+        sharded-fit recommendation on predicted OOM. The ``with_accum``
+        contract holds: the twin shares nothing with this engine's
+        executables, so probing it cannot perturb the dispatch path."""
+        return TrainEngine(
+            self.loss_fn,
+            self.optimizer,
+            mesh,
+            accum_steps=self.accum_steps,
             schedule=self.schedule,
             donate_state=bool(self._donate),
             sharding_rules=self.sharding_rules,

@@ -117,6 +117,8 @@ class Trainer:
         logger=None,
         *,
         mesh: jax.sharding.Mesh | None = None,
+        sharding_rules="auto",
+        fsdp_min_size: int = 2**18,
         seed: int = 0,
         accum_steps: int = 1,
         num_workers: int = 8,
@@ -320,10 +322,46 @@ class Trainer:
         )
 
         # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE
-        # env reads + DDP wrap, ``:48-52``).
+        # env reads + DDP wrap, ``:48-52``). mesh=None is the historical
+        # pure-DP program (1-D data mesh over every device, replicated
+        # params — trace_counts + params parity test-enforced); any
+        # MeshConfig(...).build() mesh trains sharded end to end
+        # (docs/parallelism.md): state initializes directly into the
+        # fsdp/tensor layout, chained windows / checkpoints / preflight all
+        # operate on the sharded arrays.
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
         self.world_size = self.mesh.devices.size
+        # Batch-dim divisibility is against the BATCH-SHARDED axes product
+        # (data x fsdp — parallel.mesh.batch_shard_extent), not the device
+        # count: a data=2/tensor=4 mesh runs 2 batch shards on 8 devices,
+        # and requiring batch % 8 == 0 would reject valid TP configs while
+        # batch % 2 != 0 would fail deep in jax array assembly instead of
+        # here with names attached.
+        self.batch_replicas = mesh_lib.batch_shard_extent(self.mesh)
+        if batch_size % self.batch_replicas:
+            raise ValueError(
+                f"global batch_size {batch_size} is not divisible by the "
+                f"mesh's batch-shard extent {self.batch_replicas} (= product "
+                "of the data and fsdp axes): every batch shard must hold the "
+                "same number of rows. Round batch_size or re-plan the mesh."
+            )
         self.local_batch_size = batch_size // jax.process_count()
+        # Parameter-sharding rules (parallel.sharding): "auto" resolves via
+        # the build_sharding_rules hook AFTER build_model runs (the hook may
+        # inspect self.model); an explicit list/None passes through. None on
+        # a pure-DP mesh is the historical replicated program. Any OTHER
+        # string is rejected here — forwarded to the engine it would crash
+        # deep inside state_shardings as a bogus (regex, spec) iterable with
+        # no mention of this knob.
+        if isinstance(sharding_rules, str) and sharding_rules != "auto":
+            raise ValueError(
+                f"sharding_rules={sharding_rules!r}: the only string value is "
+                "'auto' (resolve via build_sharding_rules). Pass None for the "
+                "replicated/FSDP-fallback default, or an explicit list of "
+                "(path_regex, PartitionSpec) rules."
+            )
+        self._sharding_rules_requested = sharding_rules
+        self.fsdp_min_size = int(fsdp_min_size)
 
         # Telemetry subsystem (ISSUE 4; docs/observability.md): structured
         # JSONL event log, goodput wall-time buckets, on-device train-health
@@ -410,6 +448,12 @@ class Trainer:
         self.schedule = schedule
         self.optimizer = self.build_optimizer(self.schedule)
 
+        self.sharding_rules = (
+            self.build_sharding_rules()
+            if isinstance(self._sharding_rules_requested, str)
+            and self._sharding_rules_requested == "auto"
+            else self._sharding_rules_requested
+        )
         self.engine = TrainEngine(
             self.build_loss_fn(),
             self.optimizer,
@@ -420,14 +464,21 @@ class Trainer:
             precision=self.precision,
             loss_scale=self._initial_loss_scale,
             stats=self.telemetry.stats if self.telemetry is not None else False,
+            sharding_rules=self.sharding_rules,
+            fsdp_min_size=self.fsdp_min_size,
         )
 
         # State init (replaces model.to(device) + DDP param broadcast).
+        # Sharded init: init_state jits the model init with the engine's
+        # state sharding as OUTPUT sharding, so fsdp/tensor-sharded params
+        # materialize directly into their shards — a model too big for one
+        # chip's HBM never exists replicated anywhere.
         example = self.build_example_input()
         self.state = self.engine.init_state(
             jax.random.key(seed),
             lambda rng: self.model.init(rng, example),
         )
+        self._log_sharded_layout()
 
         # Snapshot resume (``:44-45,96-101``). "latest_valid" resolves to the
         # newest checkpoint that passes integrity validation — the automatic
@@ -535,6 +586,8 @@ class Trainer:
                 resumed_step_in_epoch=self._resume_step_in_epoch,
                 processes=jax.process_count(),
                 devices=self.world_size,
+                mesh={str(k): int(v) for k, v in self.mesh.shape.items()},
+                batch_replicas=self.batch_replicas,
                 chain_steps=self.chain_steps,
                 compute_dtype=str(jnp.dtype(self.precision.compute_dtype)),
             )
@@ -672,6 +725,32 @@ class Trainer:
         # commit error surfaced) before the run declares itself finished.
         self.saver.flush()
         self.log("Finished!")
+
+    def _log_sharded_layout(self) -> None:
+        """One construction-time line saying what the mesh actually did to
+        the state: how many leaves landed sharded, and the per-device vs
+        global param bytes (the measurable ZeRO-3 win). Silent on a pure-DP
+        mesh — the historical console transcript is part of the historical
+        program."""
+        from distributed_training_pytorch_tpu.parallel import sharding as sharding_lib
+
+        record = sharding_lib.sharding_record(self.state)
+        if record is None:
+            return
+        n_sharded = len(record["specs"])
+        # Denominator over the SAME tree the record scanned (the full
+        # state): a sharded model_state leaf must not produce a >100%
+        # fraction against a params+opt_state-only count.
+        n_leaves = len(jax.tree.leaves(self.state))
+        global_bytes = sharding_lib.tree_shard_bytes(
+            self.state.params, jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        )
+        per_device = sharding_lib.tree_shard_bytes(self.state.params)
+        self.log(
+            f"mesh {record['mesh']}: {n_sharded}/{n_leaves} state leaves "
+            f"sharded; per-device param bytes {int(per_device)} "
+            f"(global {int(global_bytes)})"
+        )
 
     @property
     def model_dtype(self):
@@ -1727,6 +1806,26 @@ class Trainer:
 
     def build_scheduler(self):
         raise NotImplementedError("Please implement the build_scheduler method")
+
+    def build_sharding_rules(self):
+        """Advanced hook (the ``build_loss_fn`` convention): the explicit
+        ``(path_regex, PartitionSpec)`` parameter-sharding rules handed to
+        the engine when the ctor's ``sharding_rules="auto"`` (the default).
+        The default is ``parallel.default_sharding_rules(mesh)`` — the one
+        resolution policy shared with bench.py's BENCH_MESH setup, so the
+        bench measures the same program the Trainer runs: a mesh with a
+        nontrivial ``tensor`` axis gets ``transformer_tp_rules()``
+        (Megatron-style TP for the ViT/LM transformer blocks — conv models
+        match none of its patterns and fall through to the FSDP/replicated
+        fallback), any other mesh gets None (pure FSDP via ``spec_for_leaf``
+        / ``_fsdp_spec``, or fully replicated on a pure-data mesh — the
+        historical program). Override to hand-place specs for a custom
+        model."""
+        from distributed_training_pytorch_tpu.parallel import (
+            default_sharding_rules,
+        )
+
+        return default_sharding_rules(self.mesh)
 
     def build_loss_fn(self):
         """Advanced hook (beyond the reference's nine): the full functional

@@ -23,7 +23,9 @@ Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``EPOCHS`` (default 150),
 ``BATCH`` (global, default 128), ``DIGITS_LR``, ``SAVE_DIR`` (default
 ./runs/digits), ``DTYPE`` (fp32|bf16|fp16 mixed-precision policy, default
 fp32 — docs/mixed_precision.md), ``TELEMETRY`` (1 = event log + goodput +
-train-health stats + MFU — docs/observability.md).
+train-health stats + MFU — docs/observability.md), ``MESH`` (a mesh spec
+like ``fsdp4x2`` or ``dp2fsdp2tp2`` — sharded FSDP/TP training,
+docs/parallelism.md; unset = pure DP).
 """
 
 from __future__ import annotations
@@ -44,6 +46,7 @@ from distributed_training_pytorch_tpu.data.transforms import (
     resize,
 )
 from distributed_training_pytorch_tpu.ops import multistep_lr
+from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
 from examples.digits_data import LABELS, SIZE, materialize
@@ -124,6 +127,10 @@ if __name__ == "__main__":
         max_epoch=int(os.environ.get("EPOCHS", "150")),
         batch_size=int(os.environ.get("BATCH", "128")),
         chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # MESH (the CHAIN_STEPS/DTYPE convention): a mesh spec like
+        # "fsdp4x2" or "dp2fsdp2tp2" trains sharded end to end
+        # (docs/parallelism.md); unset = the historical pure-DP program.
+        mesh=mesh_from_env(),
         # DTYPE (mirrors CHAIN_STEPS): fp32|bf16|fp16 mixed-precision policy;
         # the model's activation dtype follows via ExampleTrainer.build_model
         # (docs/mixed_precision.md). Default fp32 = reference parity.

@@ -41,6 +41,7 @@ from distributed_training_pytorch_tpu.data import ArrayDataSource, RecordFileSou
 from distributed_training_pytorch_tpu.data import transforms as T
 from distributed_training_pytorch_tpu.models import create_model
 from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, warmup_cosine_lr
+from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng
@@ -242,6 +243,10 @@ if __name__ == "__main__":
         max_epoch=int(os.environ.get("EPOCHS", "90")),
         batch_size=int(os.environ.get("BATCH", "1024")),
         chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # MESH (the CHAIN_STEPS/DTYPE convention): a mesh spec like
+        # "fsdp4x2" or "dp2fsdp2tp2" trains sharded end to end
+        # (docs/parallelism.md); unset = the historical pure-DP program.
+        mesh=mesh_from_env(),
         # TELEMETRY=1 (mirrors DTYPE/CHAIN_STEPS): telemetry subsystem —
         # docs/observability.md. Unset = historical program.
         telemetry=os.environ.get("TELEMETRY") == "1" or None,

@@ -42,6 +42,16 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 8 virtual host devices (the tests/conftest.py convention) so the HLO
+# audit's SPMD pass — donation + precision on a data=2/fsdp=2/tensor=2
+# mesh with genuinely sharded state — always runs in the verify gate, not
+# only under pytest. Must happen before jax first initializes its CPU
+# client; appended (not overwritten) so caller-supplied XLA_FLAGS survive.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 

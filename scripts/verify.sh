@@ -14,8 +14,12 @@
 # waiver printed with its reason), and the compiled-program HLO audit
 # (100% param/opt-state donation on the real single-step AND chained
 # programs, no fp32 dot/conv under bf16, no host callbacks in the chained
-# window). The gate's teeth are tested on every run: an injected lint
-# violation and an injected undonated lowering must each make it FAIL.
+# window). The audit runs on 8 forced-host devices so the same donation +
+# precision invariants are ALSO verified on SPMD-partitioned programs over
+# a data=2/fsdp=2/tensor=2 mesh with genuinely sharded state (ISSUE 10).
+# The gate's teeth are tested on every run: an injected lint violation and
+# an injected undonated lowering (sharded programs included) must each
+# make it FAIL.
 #
 # Stage 3 is a ~8s CPU run through the real chained Trainer hot path
 # asserting (via the engine's compilation counters) that the chained
@@ -42,26 +46,34 @@
 # unfittable capacity MUST fail preflight with a finite, actually-fitting
 # batch recommendation (the perf-gate "gate has teeth" pattern).
 #
-# Stage 7 is the chaos soak in --quick mode: a real digits training job killed
+# Stage 7 is the sharded-training smoke (docs/parallelism.md): on 8
+# forced-host CPU devices, an fsdp=8 run must be BIT-EXACT with pure DP
+# (losses + params), a data=2/fsdp=2/tensor=2 run must match DP to
+# float32-ULP with bit-exact sharded init, the sharded chained trainer must
+# compile once per shape, and a SIGTERM-killed fsdp=8 run must resume under
+# a pure-DP mesh (the resharding restore path) and finish bit-exact with an
+# uninterrupted run.
+#
+# Stage 8 is the chaos soak in --quick mode: a real digits training job killed
 # 3 times (graceful SIGTERM, SIGKILL mid-background-commit, SIGKILL mid-
 # chained-window) at seeded offsets, resumed after each kill, asserting every
 # kill leaves >= 1 valid checkpoint, the final params are bit-exact with an
 # uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
 # save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
 #
-# Stage 8 is the perf-regression gate (docs/profiling.md): a ~10s CPU
+# Stage 9 is the perf-regression gate (docs/profiling.md): a ~10s CPU
 # measurement of the real chained-engine path, gated as a machine-portable
 # calibrated ratio against the committed PERF_BASELINE.json — a step-time
 # regression past tolerance (an accidental retrace, a lost chained dispatch
 # path) fails here. The gate's own teeth are tested on every run: a
 # deliberate 3x injected slowdown must make it FAIL.
 #
-# Stage 9 is the ROADMAP.md tier-1 command verbatim.
+# Stage 10 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/9: import health (pytest --collect-only) =="
+echo "== stage 1/10: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -70,7 +82,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/9: static audit (generic + jaxlint + HLO) =="
+echo "== stage 2/10: static audit (generic + jaxlint + HLO) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md)"
@@ -88,25 +100,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation hlo \
 fi
 echo "static_audit self-tests OK: injected lint + donation violations correctly failed"
 
-echo "== stage 3/9: chained-dispatch retrace guard =="
+echo "== stage 3/10: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/9: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/10: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/9: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/10: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/9: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/10: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -116,25 +128,31 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/9: chaos soak (kill/resume, async checkpointing) =="
-if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
-  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+echo "== stage 7/10: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
+  echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/9: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 8/10: chaos soak (kill/resume, async checkpointing) =="
+if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
+  echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
+  exit 9
+fi
+
+echo "== stage 9/10: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
-  exit 9
+  exit 10
 fi
 if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; then
   echo "PERF GATE SELF-TEST FAILED — a 3x injected regression PASSED the gate"
-  exit 9
+  exit 10
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 9/9: tier-1 test suite =="
+echo "== stage 10/10: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

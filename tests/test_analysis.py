@@ -453,6 +453,45 @@ class TestEngineDonationParity:
         assert not report.ok
         assert not report.single.ok and not report.chained.ok
         assert report.single.undonated_bytes == report.single.audited_bytes
+        # ISSUE 10 satellite: the injected-violation self-test covers the
+        # SHARDED path too — an undonated SPMD program must fail its audit.
+        assert report.sharded
+        assert not report.sharded_single.ok and not report.sharded_chained.ok
+
+    def test_sharded_programs_donate_all_state_bytes(self):
+        # ISSUE 10 satellite: 100% param+opt-state donation and no
+        # precision leaks must hold under SPMD partitioning (the 8-device
+        # conftest platform always runs the sharded audit), and the audited
+        # state must be GENUINELY sharded — fsdp and tensor specs both
+        # present — or the pass would be vacuous.
+        from distributed_training_pytorch_tpu.analysis.hlo_audit import (
+            _AUDIT_FSDP_MIN_SIZE,
+            _AUDIT_SHARDING_RULES,
+            _audit_mesh,
+            build_audit_engine,
+        )
+
+        report = run_hlo_audit(chain_steps=3)
+        assert report.sharded
+        assert report.sharded_single.ok
+        assert report.sharded_single.donated_fraction == 1.0
+        assert report.sharded_chained.ok
+        assert report.sharded_chained.donated_fraction == 1.0
+        assert report.sharded_precision.ok
+        engine, state, _ = build_audit_engine(
+            mesh=_audit_mesh(),
+            sharding_rules=_AUDIT_SHARDING_RULES,
+            fsdp_min_size=_AUDIT_FSDP_MIN_SIZE,
+        )
+        specs = [
+            str(s.spec)
+            for s in jax.tree.leaves(
+                engine.state_sharding_tree(state),
+                is_leaf=lambda x: hasattr(x, "spec"),
+            )
+        ]
+        assert any("fsdp" in s for s in specs), specs
+        assert any("tensor" in s for s in specs), specs
 
     def test_chained_probe_matches_real_dispatch_program(self):
         # The audit's chained probe (no trace-count side effects) and the

@@ -59,7 +59,7 @@ def vgg16_train_flops_per_image(model: VGG16, image_size: int) -> float:
     forward (standard MFU convention; pooling/activations not counted)."""
     fwd = 0.0
     size, in_ch = image_size, 3
-    for feats, layers in zip(model.stage_features, model.stage_layers):
+    for feats, layers in zip(model.stage_features, model.stage_layers, strict=True):
         for _ in range(layers):
             fwd += 2.0 * 9.0 * in_ch * feats * size * size  # 3x3 conv, same pad
             in_ch = feats
@@ -115,7 +115,7 @@ def convnext_train_flops_per_image(model, image_size: int) -> float:
     dim<->4dim MLP pair per block + 2x2 downsamples; backward = 2x forward)."""
     size = image_size // 4
     fwd = 2.0 * size * size * 16 * 3 * model.dims[0]  # 4x4/4 stem
-    for stage, (depth, dim) in enumerate(zip(model.depths, model.dims)):
+    for stage, (depth, dim) in enumerate(zip(model.depths, model.dims, strict=True)):
         if stage > 0:
             size //= 2
             fwd += 2.0 * size * size * 4 * model.dims[stage - 1] * dim  # 2x2/2
@@ -736,6 +736,20 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
         predicted=ctx.get("predicted_peak_bytes") if ctx is not None else None,
     )
     arith_intensity = hlo_flops.arithmetic_intensity(compiled if chain else probe)
+    # BENCH_MESH comm fields (ISSUE 11): per-category collective bytes of
+    # the TIMED executable via the SAME inventory code path the static
+    # audit's comm gate checks (analysis.comm_audit.collective_inventory) —
+    # a measured sweep entry and the gate argue about identical numbers.
+    # The chained executable is a rolled scan whose body (and so each
+    # collective) appears once: a per-step figure by the cost_analysis
+    # convention. Read here, while the executable is alive.
+    comm_fields = {}
+    if setup["mesh_spec"] is not None:
+        from distributed_training_pytorch_tpu.analysis.comm_audit import (
+            comm_fields as _comm_fields,
+        )
+
+        comm_fields = _comm_fields(compiled if chain else probe, setup["mesh"])
 
     # Host dispatch gap (ISSUE 2 satellite): per-step wall time when every
     # step is dispatched from Python — the regime a Trainer WITHOUT
@@ -992,6 +1006,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
             "mesh": setup["mesh_spec"],
             "mesh_axes": {str(k): int(v) for k, v in setup["mesh"].shape.items()},
             "per_chip_param_bytes": int(tree_shard_bytes(state.params)),
+            **comm_fields,  # per-category collective bytes (ISSUE 11)
             **{
                 k: round(v, 2) if isinstance(v, float) else v
                 for k, v in mfu_lib.throughput_fields(

@@ -12,7 +12,12 @@ run as a ``scripts/verify.sh`` gate:
   waivers (``analysis.waivers``);
 * ``analysis.hlo_audit`` — invariants checked on the *compiled/lowered*
   programs themselves: full param/opt-state buffer donation, no fp32 MXU
-  ops under a low-precision policy, no host callbacks in chained windows.
+  ops under a low-precision policy, no host callbacks in chained windows;
+* ``analysis.comm_audit`` — the SPMD communication audit (ISSUE 11): a
+  static collective inventory of the partitioned single-step and chained
+  programs (per-op bytes, mesh-axis attribution), an analytic expected-comm
+  model with accidental-gather / model-exceeded failure modes, and a
+  ``COMM_BASELINE.json`` regression gate mirroring the perf gate's ritual.
 """
 
 from distributed_training_pytorch_tpu.analysis.generic import (
@@ -40,9 +45,25 @@ from distributed_training_pytorch_tpu.analysis.lint import (
     lint_paths,
     lint_source,
 )
+from distributed_training_pytorch_tpu.analysis.comm_audit import (
+    CommAuditReport,
+    CommInventory,
+    ExpectedComm,
+    collective_inventory,
+    comm_fields,
+    expected_comm,
+    run_comm_audit,
+)
 from distributed_training_pytorch_tpu.analysis.waivers import Waiver, scan_waivers
 
 __all__ = [
+    "CommAuditReport",
+    "CommInventory",
+    "ExpectedComm",
+    "collective_inventory",
+    "comm_fields",
+    "expected_comm",
+    "run_comm_audit",
     "GenericFinding",
     "GenericReport",
     "run_generic",

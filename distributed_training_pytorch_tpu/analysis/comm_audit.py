@@ -1,0 +1,857 @@
+"""SPMD communication audit: what the partitioner *actually* emitted.
+
+PR 9 wired FSDP/TP meshes into the Trainer hot path; XLA's SPMD partitioner
+inserts every collective. Nothing verified the result: a one-line
+sharding-rule mistake silently turns a reduce-scatter into a full-parameter
+all-gather, and the only symptom is a flat bench round. This module is the
+analysis/ subsystem's third pillar — PR 7 reads donation out of the compiled
+program, PR 8 reads memory, this reads *communication*:
+
+**Inventory** — every ``all-reduce`` / ``all-gather`` / ``reduce-scatter`` /
+``collective-permute`` / ``all-to-all`` in the optimized HLO of the real
+SPMD-partitioned single-step AND chained programs (via the existing
+``TrainEngine.compile_step_probe`` machinery on abstract avals: zero device
+execution, CPU-viable under forced host devices), each with its byte volume
+and the mesh axes its device groups span. Byte convention: the *logical
+tensor size communicated* — ``max(operand bytes, result bytes)`` — so an
+all-gather (small in, full out), an all-reduce (full both sides) and a
+reduce-scatter (full in, shard out) of the same tensor all count its full
+bytes, and the figure is lowering-invariant (this CPU backend legally lowers
+a grad reduce-scatter as all-reduce + slice — measured — and both spellings
+score the same). Replica groups (iota ``[G,S]<=[dims]T(perm)`` and explicit
+``{{..}}`` forms) map back to :class:`MeshConfig` axis names through
+``parallel.mesh.device_coords``; the reported axes are the *physical* groups
+the bytes crossed (XLA may legally re-route, e.g. an fsdp gather through a
+tensor-neighbor permute — measured on the mixed mesh).
+
+**Expected-comm model** — analytic per-step comm derived from the mesh + the
+resolved sharding rules (the ISSUE 11 derivation, docs/parallelism.md):
+
+* pure DP (batch sharded, params replicated): one grad sync per param leaf
+  ≈ total param bytes;
+* ``fsdp``: + param all-gather forward and re-gather/scatter backward
+  ≈ 2 x fsdp-sharded param bytes;
+* ``tensor``: + per-layer activation syncs ≈ 2 x rows_per_replica x
+  sum(layer dims) x dtype bytes per tensor-sharded leaf (fwd + bwd).
+
+Two hard failure modes, each reported with the offending HLO op and the
+leaf/rule it traces to (``parallel.sharding.rule_for_leaf``):
+
+* **accidental-gather** — an all-gather over groups spanning an axis the
+  rules shard *without* gathering (``tensor``/``seq``; fsdp gathers params
+  by design) moving >= the full unsharded bytes of the largest such leaf.
+  This is the mis-rule signature: e.g. a rule anchored to ``.params`` only
+  leaves the momentum twin unsharded, and the optimizer update must gather
+  the full parameter every step (measured: the injected spec below).
+* **model-exceeded** — total inventory bytes > expected x (1 + tolerance).
+  A catastrophe bound (default tolerance 1.0, i.e. 2x): the model
+  deliberately over-estimates legit comm, so tripping it means comm the
+  derivation cannot explain at all. The *tight* instrument is the baseline.
+
+**Baseline gate** — per-mesh-spec single-step totals persist in a committed
+``COMM_BASELINE.json``, gated exactly like ``PERF_BASELINE.json``: the one
+``profiling.gate.check`` rule (fail iff measured > baseline x (1+tol)), the
+``--update`` ritual (``scripts/static_audit.py --update-comm-baseline``) and
+the stale nudge when comm *shrinks* past tolerance. Byte totals are
+deterministic for a given XLA, so the default tolerance (25%) only absorbs
+compiler-version lowering changes — a rule regression that doubles gather
+traffic cannot pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from distributed_training_pytorch_tpu.profiling.categories import categorize
+from distributed_training_pytorch_tpu.profiling.gate import (
+    GateResult,
+    check as gate_check,
+    load_baseline,
+    update_baseline,
+)
+from distributed_training_pytorch_tpu.utils.hlo_flops import (
+    DTYPE_BYTES,
+    OPNAME_RE,
+    aval_bytes,
+)
+
+__all__ = [
+    "COMM_OPS",
+    "COMM_BASELINE_PATH",
+    "AUDIT_MESH_SPECS",
+    "Collective",
+    "CommInventory",
+    "ExpectedComm",
+    "CommSpecReport",
+    "CommAuditReport",
+    "parse_replica_groups",
+    "mesh_axes_for_groups",
+    "collective_inventory",
+    "expected_comm",
+    "comm_findings",
+    "comm_fields",
+    "run_comm_audit",
+]
+
+# The collective opcodes this audit inventories, as they appear in optimized
+# HLO text. `categorize()` buckets every one of them as "collective" — the
+# per-op rows below join the profiler's attribution through that shared
+# categorizer (test-enforced).
+COMM_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# Repo-root COMM_BASELINE.json (this module lives two levels down) — the
+# comm twin of profiling.gate.DEFAULT_BASELINE_PATH.
+COMM_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "COMM_BASELINE.json",
+)
+
+# The audited mesh layouts: every sharding mode the Trainer hot path
+# supports, as 8-device spec strings (the docs/parallelism.md grammar) —
+# pure DP, pure FSDP, tensor x data, and the mixed mesh the HLO audit's
+# sharded twins use.
+AUDIT_MESH_SPECS = ("dp8", "fsdp8", "tp2x4", "dp2fsdp2tp2")
+
+# Axes whose *parameters* a correct program never gathers whole: fsdp
+# gathers params by design (ZeRO-3), but a tensor/seq-sharded weight stays
+# sharded through fwd+bwd — only activations cross those axes. A full-param
+# all-gather there is the mis-rule catastrophe this audit exists to catch.
+NEVER_GATHER_AXES = ("tensor", "seq")
+
+# Default tolerances: the analytic model is a deliberate over-estimate, so
+# its bound is loose (fail past 2x expected); the committed baseline is
+# deterministic per XLA version, so its gate is tight.
+MODEL_TOLERANCE = 1.0
+BASELINE_TOLERANCE = 0.25
+
+# Sync spellings AND the async `-start` halves TPU optimized HLO emits
+# (`all-gather-start`/`all-reduce-start`/...): the `-start` op carries the
+# shapes and replica groups, so it IS the collective for counting purposes;
+# the paired `-done` never matches (the regex requires `(` right after the
+# optional suffix) — counting both would double every async collective.
+_OPCODE_RE = re.compile(
+    r"(?<!%)\b(" + "|".join(re.escape(op) for op in COMM_OPS) + r")(-start)?\("
+)
+_SHAPE_RE = re.compile(r"\b([a-z]\w*)\[([0-9,]*)\]")
+_GROUPS_EXPLICIT_RE = re.compile(
+    r"replica_groups=\{(\{[\d,\s]*\}(?:,\s*\{[\d,\s]*\})*)\}"
+)
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+_GROUP_RE = re.compile(r"\{([\d,\s]*)\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\s*\d+\},?\s*)+)\}")
+
+
+@dataclasses.dataclass
+class Collective:
+    """One collective instruction of a compiled program."""
+
+    op: str  # opcode: all-reduce | all-gather | ...
+    name: str  # HLO instruction name (%all-gather.2)
+    bytes: float  # logical bytes communicated: max(operand, result)
+    axes: tuple[str, ...]  # mesh axes the device groups span
+    groups: int  # number of communicating device groups
+    group_size: int  # devices per group (2 for a permute pair)
+    result_shape: str  # result type text, for reports
+    op_name: str = ""  # jax op_name metadata (traces to the model op)
+
+    @property
+    def profile_category(self) -> str:
+        """The shared profiling bucket this op lands in (always
+        ``collective`` — the join with ``profiling.categories``)."""
+        return categorize(self.op)
+
+    def describe(self) -> str:
+        axes = "x".join(self.axes) if self.axes else "?"
+        return (
+            f"{self.name} [{self.op}] {self.result_shape} "
+            f"{int(self.bytes)} B over {axes} "
+            f"({self.groups} group(s) of {self.group_size})"
+        )
+
+
+@dataclasses.dataclass
+class CommInventory:
+    """Every collective of one compiled program, with totals."""
+
+    collectives: list[Collective]
+    label: str = ""
+    chain_length: int = 1  # informational: unrolled windows repeat per step
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(c.bytes for c in self.collectives)
+
+    def by_op(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for c in self.collectives:
+            out[c.op] = out.get(c.op, 0.0) + c.bytes
+        return out
+
+    def by_axes(self) -> dict[tuple[str, ...], float]:
+        out: dict[tuple[str, ...], float] = {}
+        for c in self.collectives:
+            out[c.axes] = out.get(c.axes, 0.0) + c.bytes
+        return out
+
+    def describe(self) -> str:
+        ops = ", ".join(
+            f"{op}={int(v)}B" for op, v in sorted(self.by_op().items())
+        )
+        axes = ", ".join(
+            f"{'x'.join(a) or '?'}={int(v)}B"
+            for a, v in sorted(self.by_axes().items())
+        )
+        return (
+            f"inventory[{self.label}]: {len(self.collectives)} collective(s), "
+            f"{int(self.total_bytes)} B total ({ops or 'none'}; per-axis: "
+            f"{axes or 'none'})"
+        )
+
+
+def parse_replica_groups(attrs: str) -> "list[tuple[int, ...]] | None":
+    """Device groups from a collective's attribute text. Handles both the
+    explicit ``replica_groups={{0,1},{2,3}}`` form and the iota form
+    ``replica_groups=[G,S]<=[dims]`` / ``...T(perm)`` (reshape an iota of
+    prod(dims) by ``dims``, transpose by ``perm``, reshape to G groups of
+    S). None when the attribute is absent (e.g. collective-permute)."""
+    m = _GROUPS_IOTA_RE.search(attrs)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",") if x]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",") if x]
+            ids = ids.transpose(perm)
+        ids = ids.reshape(n_groups, group_size)
+        return [tuple(int(i) for i in row) for row in ids]
+    m = _GROUPS_EXPLICIT_RE.search(attrs)
+    if m:
+        return [
+            tuple(int(x) for x in g.split(",") if x.strip())
+            for g in _GROUP_RE.findall(m.group(1))
+        ]
+    return None
+
+
+def _permute_groups(attrs: str) -> "list[tuple[int, ...]] | None":
+    """``source_target_pairs`` of a collective-permute as 2-device groups,
+    self-pairs (no bytes move) dropped."""
+    m = _PAIRS_RE.search(attrs)
+    if m is None:
+        return None
+    pairs = re.findall(r"\{(\d+),\s*(\d+)\}", m.group(1))
+    return [(int(s), int(t)) for s, t in pairs if s != t]
+
+
+def mesh_axes_for_groups(
+    groups: Sequence[Sequence[int]], coords: "dict[int, tuple[int, ...]]",
+    axis_names: Sequence[str],
+) -> tuple[str, ...]:
+    """The mesh axes that *vary* inside the device groups — the axes this
+    collective's bytes cross. Devices absent from ``coords`` (a program over
+    foreign devices) yield ``()`` = unmapped, never a wrong name."""
+    varying: set[int] = set()
+    for group in groups:
+        if len(group) < 2:
+            continue
+        pts = []
+        for dev in group:
+            if dev not in coords:
+                return ()
+            pts.append(coords[dev])
+        for dim in range(len(axis_names)):
+            if len({p[dim] for p in pts}) > 1:
+                varying.add(dim)
+    return tuple(axis_names[i] for i in sorted(varying))
+
+
+def _shape_bytes(segment: str) -> list[float]:
+    """Byte size of every typed shape (``f32[64,10]``) in an HLO text
+    segment, at the shared ``DTYPE_BYTES`` widths. Layout suffixes
+    (``{1,0}``) and attribute brackets never match — the regex requires a
+    dtype word before ``[``, and unknown words are skipped."""
+    sizes: list[float] = []
+    for dtype, dims in _SHAPE_RE.findall(segment):
+        if dtype not in DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * DTYPE_BYTES[dtype])
+    return sizes
+
+
+def _segment_bytes(segment: str) -> float:
+    return sum(_shape_bytes(segment))
+
+
+def collective_inventory(hlo_text: str, mesh, *, label: str = "",
+                         chain_length: int = 1) -> CommInventory:
+    """Parse every collective out of optimized HLO text, sized and mapped to
+    ``mesh``'s axes. For an unrolled chained program each step's collectives
+    appear (and count) once per step — totals scale with the window, exactly
+    like the bytes the wire carries."""
+    from distributed_training_pytorch_tpu.parallel.mesh import device_coords
+
+    coords = device_coords(mesh)
+    axis_names = tuple(mesh.axis_names)
+    out: list[Collective] = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        if " = " not in line:
+            continue
+        head, rhs = line.split(" = ", 1)
+        m = _OPCODE_RE.search(rhs)
+        if m is None:
+            continue
+        op = m.group(1)
+        is_start = m.group(2) is not None
+        result_seg = rhs[: m.start()]
+        # Operand segment: balanced-paren scan (types may nest tuples).
+        i = rhs.find("(", m.start())
+        depth, j = 0, i
+        while j < len(rhs):
+            if rhs[j] == "(":
+                depth += 1
+            elif rhs[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        operand_seg = rhs[i:j]
+        attrs = rhs[j:]
+        if op == "collective-permute":
+            groups = _permute_groups(attrs)
+        else:
+            groups = parse_replica_groups(attrs)
+        if groups is not None:
+            groups = [g for g in groups if len(g) > 1]
+            if not groups:
+                continue  # singleton groups: no bytes cross any link
+        if is_start:
+            # An async `-start` result is the (operand, output, ...) buffer
+            # tuple: summing it would double-count the collective. The
+            # largest single buffer is the communicated tensor (full size
+            # for gather/reduce either way under the max(in, out) rule).
+            volume = max(
+                _shape_bytes(result_seg) + _shape_bytes(operand_seg),
+                default=0.0,
+            )
+        else:
+            volume = max(_segment_bytes(result_seg), _segment_bytes(operand_seg))
+        opname = OPNAME_RE.search(attrs)
+        out.append(
+            Collective(
+                op=op,
+                name=head.replace("ROOT ", "").strip(),
+                bytes=volume,
+                axes=mesh_axes_for_groups(groups or (), coords, axis_names),
+                groups=len(groups) if groups else 0,
+                group_size=max((len(g) for g in groups), default=0) if groups else 0,
+                result_shape=result_seg.strip(),
+                op_name=opname.group(1) if opname else "",
+            )
+        )
+    return CommInventory(collectives=out, label=label, chain_length=chain_length)
+
+
+# -- the analytic expected-comm model ---------------------------------------
+
+
+@dataclasses.dataclass
+class ExpectedComm:
+    """Analytic per-step comm bytes derived from mesh + resolved rules."""
+
+    terms: dict  # {"grad_sync": ..., "fsdp_gather": ..., "tp_activations": ...}
+    leaves: list  # [{path, shape, dtype, bytes, axes, rule}] for param leaves
+    chain_length: int = 1
+
+    @property
+    def total(self) -> float:
+        return float(sum(self.terms.values())) * self.chain_length
+
+    def tensor_leaves(self) -> list:
+        return [
+            leaf for leaf in self.leaves
+            if any(a in NEVER_GATHER_AXES for a in leaf["axes"])
+        ]
+
+    def describe(self) -> str:
+        terms = ", ".join(f"{k}={int(v)}B" for k, v in self.terms.items() if v)
+        return (
+            f"expected model: {int(self.total)} B/window "
+            f"(x{self.chain_length} step(s); {terms or 'no comm expected'})"
+        )
+
+
+def _spec_axes(spec) -> tuple[str, ...]:
+    axes: list[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for name in entry if isinstance(entry, tuple) else (entry,):
+            axes.append(str(name))
+    return tuple(axes)
+
+
+def expected_comm(engine, state, batch, *, chain_length: int = 1) -> ExpectedComm:
+    """The ISSUE 11 model, from the engine's OWN resolved shardings (the
+    same ``state_sharding_tree`` the dispatch path lays state out with) —
+    deliberately an over-estimate of legitimate comm (grad syncs counted at
+    full leaf bytes even when the wgrad runs on shards), because its check
+    only fires *above* tolerance: what it bounds is comm the derivation
+    cannot explain at all."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+    from distributed_training_pytorch_tpu.parallel import sharding as sharding_lib
+
+    mesh = engine.mesh
+    abstract_state = jax.eval_shape(lambda s: s, state)
+    shardings = engine.state_sharding_tree(abstract_state)
+    rules = tuple(engine.sharding_rules or ())
+    state_leaves = tree_flatten_with_path(abstract_state)[0]
+    sharding_leaves = jax.tree.leaves(
+        shardings, is_leaf=lambda s: isinstance(s, jax.sharding.Sharding)
+    )
+    leaves = []
+    for (path, leaf), sharding in zip(state_leaves, sharding_leaves, strict=True):
+        path_str = keystr(path)
+        if ".params" not in path_str:
+            continue
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        spec = getattr(sharding, "spec", jax.sharding.PartitionSpec())
+        matched = sharding_lib.rule_for_leaf(path_str, shape, mesh, rules)
+        leaves.append(
+            {
+                "path": path_str,
+                "shape": shape,
+                "dtype": str(getattr(leaf, "dtype", None)),
+                "bytes": aval_bytes(shape, getattr(leaf, "dtype", None)),
+                "axes": _spec_axes(spec),
+                "rule": matched[0] if matched else None,
+            }
+        )
+    extent = mesh_lib.batch_shard_extent(mesh)
+    tensor = int(mesh.shape.get(mesh_lib.TENSOR_AXIS, 1))
+    terms = {"grad_sync": 0.0, "fsdp_gather": 0.0, "tp_activations": 0.0}
+    if extent > 1:
+        # One gradient sync per param leaf (all-reduce, or the
+        # reduce-scatter+all-gather pair ZeRO splits it into — same full
+        # bytes either way under the inventory's max(in, out) convention).
+        terms["grad_sync"] = sum(leaf["bytes"] for leaf in leaves)
+    for leaf in leaves:
+        if mesh_lib.FSDP_AXIS in leaf["axes"]:
+            # Forward all-gather + backward re-gather/scatter traffic.
+            terms["fsdp_gather"] += 2.0 * leaf["bytes"]
+    if tensor > 1:
+        batch_leaves = jax.tree.leaves(batch)
+        rows = 0
+        if batch_leaves:
+            lead = tuple(getattr(batch_leaves[0], "shape", ()) or (0,))[0]
+            rows = max(1, int(lead) // max(1, extent))
+        for leaf in leaves:
+            if any(a in NEVER_GATHER_AXES for a in leaf["axes"]):
+                # Per-layer activation syncs, fwd + bwd: rows x the layer's
+                # dim sum is a ceiling for the activation tensors that cross
+                # the tensor axis around this weight.
+                width = sum(leaf["shape"]) if leaf["shape"] else 1
+                dtype_bytes = aval_bytes((1,), leaf["dtype"])
+                terms["tp_activations"] += 2.0 * rows * width * dtype_bytes
+    return ExpectedComm(terms=terms, leaves=leaves, chain_length=chain_length)
+
+
+# -- the two failure modes --------------------------------------------------
+
+
+def comm_findings(
+    inventory: CommInventory,
+    expected: ExpectedComm,
+    *,
+    tolerance: float = MODEL_TOLERANCE,
+) -> list[dict]:
+    """Apply the two hard failure modes to one program's inventory. Each
+    finding carries the offending HLO op and the leaf/rule it traces to."""
+    findings: list[dict] = []
+    # Per-LEAF thresholds (the ISSUE 11 wording: "any collective moving >=
+    # the full unsharded param bytes"): a gather of a small kernel's full
+    # bytes must fire even when a bigger kernel exists, and attribution
+    # names the largest leaf the volume explains. Scoped to weight-shaped
+    # leaves (ndim >= 2): bias vectors are activation-scale, and a clean
+    # program's activation gathers would false-positive against them (a
+    # mis-ruled bias still shows up in the baseline gate's totals).
+    tensor_leaves = [
+        leaf for leaf in expected.tensor_leaves() if len(leaf["shape"]) >= 2
+    ]
+    if tensor_leaves:
+        for c in inventory.collectives:
+            if c.op != "all-gather":
+                continue
+            if not any(a in NEVER_GATHER_AXES for a in c.axes):
+                continue
+            explained = [
+                leaf for leaf in tensor_leaves if c.bytes >= leaf["bytes"]
+            ]
+            if not explained:
+                continue
+            leaf = max(explained, key=lambda x: x["bytes"])
+            findings.append(
+                {
+                    "kind": "accidental-gather",
+                    "op": c.name,
+                    "bytes": c.bytes,
+                    "axes": c.axes,
+                    "leaf": leaf["path"],
+                    "rule": leaf["rule"],
+                    "detail": (
+                        f"{c.name} moves {int(c.bytes)} B over "
+                        f"{'x'.join(c.axes)} — >= the full unsharded "
+                        f"{int(leaf['bytes'])} B of {leaf['path']} "
+                        f"(rule {leaf['rule']!r}): a {'/'.join(NEVER_GATHER_AXES)}-"
+                        "sharded parameter must never be gathered whole; "
+                        "this is the mis-rule signature (a reduce-scatter "
+                        "turned into a full param all-gather)"
+                    ),
+                }
+            )
+    if expected.total > 0 and inventory.total_bytes > expected.total * (1.0 + tolerance):
+        worst = max(inventory.collectives, key=lambda c: c.bytes, default=None)
+        findings.append(
+            {
+                "kind": "model-exceeded",
+                "op": worst.name if worst else "",
+                "bytes": inventory.total_bytes,
+                "axes": worst.axes if worst else (),
+                "leaf": None,
+                "rule": None,
+                "detail": (
+                    f"total comm {int(inventory.total_bytes)} B exceeds the "
+                    f"analytic model's {int(expected.total)} B x "
+                    f"(1+{tolerance:g}) — comm the mesh+rules derivation "
+                    "cannot explain (largest op: "
+                    f"{worst.describe() if worst else 'n/a'})"
+                ),
+            }
+        )
+    elif expected.total == 0 and inventory.total_bytes > 0:
+        findings.append(
+            {
+                "kind": "model-exceeded",
+                "op": inventory.collectives[0].name,
+                "bytes": inventory.total_bytes,
+                "axes": inventory.collectives[0].axes,
+                "leaf": None,
+                "rule": None,
+                "detail": (
+                    f"model expects ZERO comm on this mesh but the program "
+                    f"moves {int(inventory.total_bytes)} B"
+                ),
+            }
+        )
+    return findings
+
+
+# -- per-mesh-spec audit + the gate -----------------------------------------
+
+
+@dataclasses.dataclass
+class CommSpecReport:
+    """One mesh layout's audit: single + chained inventories, the model,
+    findings, and the baseline verdict."""
+
+    spec: str
+    single: CommInventory
+    chained: CommInventory
+    expected: ExpectedComm
+    chain_steps: int
+    findings: list
+    gate: GateResult | None = None
+    injected: bool = False
+
+    @property
+    def ok(self) -> bool:
+        if self.findings:
+            return False
+        return self.gate is None or self.gate.passed
+
+    def measurement(self) -> dict:
+        """The JSON-safe baseline entry for this spec (the figures
+        ``COMM_BASELINE.json`` persists and the gate re-measures)."""
+        return {
+            "comm_bytes_per_step": round(self.single.total_bytes, 1),
+            "comm_bytes_chained": round(self.chained.total_bytes, 1),
+            "chain_steps": self.chain_steps,
+            "collectives": len(self.single.collectives),
+            "platform": jax.devices()[0].platform,
+            "workload": "auditnet-conv8-dense10",
+        }
+
+    def describe(self) -> str:
+        lines = [f"comm[{self.spec}]:"]
+        lines.append("    " + self.single.describe())
+        lines.append("    " + self.chained.describe())
+        lines.append("    " + self.expected.describe())
+        for f in self.findings:
+            lines.append(f"    FAIL {f['kind']}: {f['detail']}")
+        if self.gate is not None:
+            lines.append("    " + self.gate.describe())
+        if self.ok and not self.findings:
+            lines.append("    OK")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class CommAuditReport:
+    specs: list
+    injected: bool = False
+    skipped: "str | None" = None
+
+    @property
+    def ok(self) -> bool:
+        if self.skipped is not None:
+            return True  # skipped-and-says-so, the sharded-audit contract
+        return all(s.ok for s in self.specs)
+
+    def describe(self) -> str:
+        if self.skipped is not None:
+            return f"  comm audit: SKIPPED ({self.skipped})"
+        return "\n".join("  " + s.describe() for s in self.specs)
+
+    def to_fields(self) -> dict:
+        """Flat JSON-safe summary for the ``static_audit`` telemetry event."""
+        if self.skipped is not None:
+            return {"comm_skipped": self.skipped, "comm_passed": True}
+        return {
+            "comm_bytes": {
+                s.spec: round(s.single.total_bytes, 1) for s in self.specs
+            },
+            "comm_findings": sum(len(s.findings) for s in self.specs),
+            "comm_gate_failures": sum(
+                1 for s in self.specs if s.gate is not None and not s.gate.passed
+            ),
+            "comm_injected": self.injected,
+            "comm_passed": self.ok,
+        }
+
+
+# The injected mis-rule (the --inject-violation comm seam): anchored to the
+# *params* subtree only, so the momentum twin in opt_state falls back to
+# replicated on a tensor x data mesh — the optimizer update then has a
+# tensor-sharded gradient feeding a replicated momentum leaf, and the
+# partitioner MUST all-gather the full parameter-shaped buffer every step
+# (measured: `all-gather f32[512,5]->[512,10]` over the tensor axis, the
+# exact full-kernel 20480 B). One over-anchored regex = the one-line
+# sharding-rule mistake the motivation names.
+_MISRULED_TP_RULES = (
+    (r"\.params\['Dense_0'\]\['kernel'\]",
+     jax.sharding.PartitionSpec(None, "tensor")),
+)
+
+
+def _spec_engine(spec: str, *, rules="auto"):
+    """Audit engine for one mesh-spec string over the first 8 devices, with
+    the HLO audit's fixture conventions (low ``fsdp_min_size`` + explicit TP
+    rule so the small fixture leaves genuinely shard)."""
+    from distributed_training_pytorch_tpu.analysis.hlo_audit import (
+        _AUDIT_FSDP_MIN_SIZE,
+        _AUDIT_SHARDING_RULES,
+        build_audit_engine,
+    )
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.mesh_config_from_spec(spec).build(devices=jax.devices()[:8])
+    if rules == "auto":
+        rules = (
+            _AUDIT_SHARDING_RULES
+            if mesh.shape.get(mesh_lib.TENSOR_AXIS, 1) > 1
+            else None
+        )
+    return build_audit_engine(
+        mesh=mesh, sharding_rules=rules, fsdp_min_size=_AUDIT_FSDP_MIN_SIZE
+    )
+
+
+def audit_comm_spec(
+    spec: str,
+    *,
+    chain_steps: int = 4,
+    rules="auto",
+    tolerance: float = MODEL_TOLERANCE,
+    injected: bool = False,
+) -> CommSpecReport:
+    """Inventory + model + failure modes for one mesh layout's real
+    single-step AND chained programs (abstract lowerings only)."""
+    from distributed_training_pytorch_tpu.train.engine import stack_chain_batch
+
+    engine, state, batch = _spec_engine(spec, rules=rules)
+    single_compiled = engine.compile_step_probe(state, batch, donate=True)
+    single = collective_inventory(
+        single_compiled.as_text(), engine.mesh, label=f"{spec} single-step"
+    )
+    window = stack_chain_batch(batch, chain_steps)
+    chained_compiled = engine.compile_step_probe(
+        state, window, donate=True, chain_length=chain_steps
+    )
+    chained = collective_inventory(
+        chained_compiled.as_text(),
+        engine.mesh,
+        label=f"{spec} chained x{chain_steps}",
+        chain_length=chain_steps,
+    )
+    expected = expected_comm(engine, state, batch)
+    findings = comm_findings(single, expected, tolerance=tolerance)
+    expected_window = expected_comm(
+        engine, state, batch, chain_length=chain_steps
+    )
+    findings += comm_findings(chained, expected_window, tolerance=tolerance)
+    return CommSpecReport(
+        spec=spec,
+        single=single,
+        chained=chained,
+        expected=expected,
+        chain_steps=chain_steps,
+        findings=findings,
+        injected=injected,
+    )
+
+
+def run_comm_audit(
+    chain_steps: int = 4,
+    *,
+    inject_violation: bool = False,
+    baseline: "dict | None" = None,
+    model_tolerance: float = MODEL_TOLERANCE,
+) -> CommAuditReport:
+    """The full comm audit: every :data:`AUDIT_MESH_SPECS` layout's real
+    single-step and chained programs, each gated against ``baseline`` (a
+    loaded ``COMM_BASELINE.json`` dict; None = no baseline gating — the
+    tests' mode). ``inject_violation=True`` audits ONLY the mis-ruled TP
+    spec, which MUST come back failing with an accidental-gather finding —
+    the self-test exercises the detector; the clean specs already ran in
+    the clean pass, and re-auditing them would double verify.sh's stage-2
+    comm cost for zero coverage.
+
+    Needs >= 8 devices (the forced-host-platform convention shared with the
+    HLO audit's sharded twins); fewer -> a report that says SKIPPED rather
+    than a vacuous pass."""
+    if jax.device_count() < 8:
+        return CommAuditReport(
+            specs=[],
+            injected=inject_violation,
+            skipped=(
+                f"needs >= 8 devices for the audited meshes, have "
+                f"{jax.device_count()} (scripts/static_audit.py forces an "
+                "8-device host platform via compat.force_host_devices)"
+            ),
+        )
+    if inject_violation:
+        report = audit_comm_spec(
+            "tp2x4",
+            chain_steps=chain_steps,
+            rules=_MISRULED_TP_RULES,
+            tolerance=model_tolerance,
+            injected=True,
+        )
+        report.spec = "tp2x4(mis-ruled)"
+        return CommAuditReport(specs=[report], injected=True)
+    reports: list[CommSpecReport] = []
+    for spec in AUDIT_MESH_SPECS:
+        report = audit_comm_spec(
+            spec, chain_steps=chain_steps, tolerance=model_tolerance
+        )
+        if baseline is not None:
+            entries = baseline.get("entries", {})
+            if spec not in entries:
+                report.findings.append(
+                    {
+                        "kind": "no-baseline",
+                        "op": "",
+                        "bytes": report.single.total_bytes,
+                        "axes": (),
+                        "leaf": None,
+                        "rule": None,
+                        "detail": (
+                            f"no COMM_BASELINE.json entry {spec!r} — record "
+                            "one with scripts/static_audit.py "
+                            "--update-comm-baseline"
+                        ),
+                    }
+                )
+            else:
+                tol = baseline.get("tolerance", {}).get(spec, BASELINE_TOLERANCE)
+                report.gate = gate_check(
+                    report.single.total_bytes,
+                    float(entries[spec]["comm_bytes_per_step"]),
+                    float(tol),
+                    key=spec,
+                    metric="comm_bytes_per_step",
+                )
+        reports.append(report)
+    return CommAuditReport(specs=reports, injected=False)
+
+
+def record_comm_baseline(
+    path: str = COMM_BASELINE_PATH,
+    *,
+    chain_steps: int = 4,
+    tolerance: float = BASELINE_TOLERANCE,
+) -> CommAuditReport:
+    """The ``--update`` ritual: re-measure every audited spec and persist
+    its totals (refusing to record a failing audit — a baseline must never
+    memorialize a mis-ruled program). Uses ``profiling.gate``'s writer, so
+    the file format, atomic replace, and torn-file recovery match
+    ``PERF_BASELINE.json`` exactly."""
+    report = run_comm_audit(chain_steps=chain_steps, baseline=None)
+    if not report.ok or report.skipped is not None:
+        raise ValueError(
+            "refusing to record COMM_BASELINE.json from a failing or "
+            "skipped audit:\n" + report.describe()
+        )
+    for spec_report in report.specs:
+        update_baseline(
+            path, spec_report.spec, spec_report.measurement(), tolerance=tolerance
+        )
+    return report
+
+
+def load_comm_baseline(path: str = COMM_BASELINE_PATH) -> dict:
+    """``profiling.gate.load_baseline`` on the comm file — one loader, one
+    schema (``{"entries": ..., "tolerance": ...}``)."""
+    return load_baseline(path)
+
+
+def comm_fields(compiled, mesh) -> dict:
+    """Bench-facing summary of one compiled executable's collectives — the
+    SAME inventory code path the gate checks, so a ``BENCH_MESH`` sweep
+    entry and the audit argue about identical numbers. For a rolled-scan
+    chained executable (``compile_chained_train_steps``) the loop body — and
+    so each collective — appears once, making this a per-step figure by the
+    same convention ``cost_analysis()`` uses. Never raises: a parse failure
+    costs only these fields (the bench-profile degradation contract)."""
+    try:
+        inventory = collective_inventory(compiled.as_text(), mesh)
+        return {
+            "comm_bytes_per_step": int(inventory.total_bytes),
+            "comm_collectives": len(inventory.collectives),
+            "comm": {op: int(v) for op, v in sorted(inventory.by_op().items())},
+        }
+    except Exception as e:  # pragma: no cover - defensive: bench must not die
+        import warnings
+
+        warnings.warn(f"comm_fields: inventory failed ({e}); fields omitted")
+        return {}

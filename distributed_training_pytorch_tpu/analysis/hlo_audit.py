@@ -367,9 +367,11 @@ def build_audit_engine(precision=None, mesh=None, *, sharding_rules=None,
 
 
 def _stack_abstract(batch: dict, length: int) -> dict:
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct((length,) + tuple(x.shape), x.dtype), batch
-    )
+    # Shared stacking rule (train.engine): the audited window shape is the
+    # dispatched one by construction.
+    from distributed_training_pytorch_tpu.train.engine import stack_chain_batch
+
+    return stack_chain_batch(batch, length)
 
 
 @dataclasses.dataclass

@@ -39,6 +39,17 @@ with the history):
     across the update, doubling state memory — the ROADMAP item 3
     donation-audit concern, at the source level (``analysis.hlo_audit``
     checks the same invariant on the compiled program).
+``zip-no-strict``
+    ``zip()`` over pytree-leaf iterables without an explicit ``strict=``.
+    Two leaf lists zipped lazily truncate to the shorter one — a partial
+    shardings tree silently undercounted the preflight's per-device byte
+    total this exact way (the PR 9 review fix in
+    ``parallel.sharding.tree_shard_bytes``); a structure mismatch must be
+    an error, not "fewer leaves". Scoped to zips whose arguments touch tree
+    leaves (``tree.leaves``/``tree_flatten``/``leaves``-named iterables) —
+    config-tuple zips are the generic layer's business (ruff B905 backstops
+    repo-wide when installed). ``strict=False`` is accepted: it documents
+    that truncation is the contract.
 
 Static analysis is heuristic; false positives are waived inline —
 ``# jaxlint: disable=<rule> -- <reason>`` (``analysis.waivers``) — and every
@@ -72,6 +83,8 @@ RULES = {
     "target without holding a lock",
     "bare-except": "bare except: swallows KeyboardInterrupt/SystemExit",
     "missing-donate-on-jit": "state-carrying jax.jit without donate_argnums",
+    "zip-no-strict": "zip() over pytree-leaf iterables without strict= "
+    "(silent truncation on structure mismatch)",
     "waiver-missing-reason": "jaxlint disable comment without a '-- reason'",
 }
 
@@ -561,6 +574,39 @@ class _ModuleLint:
                             "donate_argnums",
                         )
 
+    def check_zip_strict(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "zip"
+            ):
+                continue
+            if any(kw.arg == "strict" for kw in node.keywords):
+                continue
+            if len(node.args) < 2 or any(
+                isinstance(a, ast.Starred) for a in node.args
+            ):
+                # zip(*rows) transposes one iterable — no two trees to
+                # mismatch; single-arg zips likewise.
+                continue
+            treeish = any(
+                "leaves" in ident
+                or "flatten" in ident
+                or ident in ("tree", "tree_util", "treedef")
+                for arg in node.args
+                for ident in _identifiers(arg)
+            )
+            if not treeish:
+                continue
+            self.emit(
+                "zip-no-strict", node,
+                "zip() over pytree leaves without strict= silently truncates "
+                "to the shorter tree on a structure mismatch (the PR 9 "
+                "partial-shardings undercount); pass strict=True, or "
+                "strict=False if truncation really is the contract",
+            )
+
     @staticmethod
     def _first_param(fn: ast.AST) -> str | None:
         args = fn.args.args
@@ -580,6 +626,7 @@ class _ModuleLint:
         self.check_file_writes()
         self.check_cross_thread()
         self.check_missing_donate()
+        self.check_zip_strict()
         self.findings.sort(key=lambda f: (f.line, f.rule))
         return self.findings
 

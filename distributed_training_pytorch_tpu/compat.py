@@ -23,9 +23,53 @@ site imports them from here instead of from ``jax`` directly:
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import jax
+
+
+def force_host_devices(n: int = 8) -> None:
+    """Force an ``n``-device virtual CPU platform (the multi-chip test rig).
+
+    The ONE implementation of the ``--xla_force_host_platform_device_count``
+    setup that ``tests/conftest.py``, ``scripts/static_audit.py``,
+    ``scripts/sharding_smoke.py``, and ``scripts/repro_triple_check.py``
+    each used to hand-roll (ISSUE 11 satellite): appends the flag to
+    ``XLA_FLAGS`` (never overwrites caller-supplied flags, and never doubles
+    an existing count), pins ``JAX_PLATFORMS=cpu`` via env AND jax config
+    (the environment may pre-import jax with a TPU plugin registered —
+    sitecustomize — so both knobs are needed).
+
+    Must run before jax first initializes its CPU client — the backend
+    reads ``XLA_FLAGS`` exactly once, at its own first initialization.
+    Merely *importing* jax (or this package) does not initialize it, so
+    calling this right after imports is safe; calling it after something
+    touched ``jax.devices()`` is too late and raises."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={int(n)}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:  # private probe, best-effort across the supported jax range
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except (ImportError, AttributeError):
+        return
+    if initialized:
+        if (
+            jax.device_count() == int(n)
+            and jax.devices()[0].platform == "cpu"
+        ):
+            return  # already in the requested state — idempotent re-call
+        raise RuntimeError(
+            "force_host_devices called after the JAX backend initialized — "
+            "the device count cannot change anymore; call it before anything "
+            "touches jax.devices()"
+        )
 
 try:  # jax >= 0.6: shard_map is a top-level public API
     from jax import shard_map as _shard_map

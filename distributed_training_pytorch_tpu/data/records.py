@@ -352,7 +352,7 @@ class NativeRecordFileSource(RecordFileSource):
         from distributed_training_pytorch_tpu.data.native import mixed_native_batch
 
         payloads, labels = map(
-            list, zip(*(self.read_record_tolerant(int(i)) for i in rows))
+            list, zip(*(self.read_record_tolerant(int(i)) for i in rows), strict=True)
         )
         if self._native is not None:
 
@@ -515,7 +515,7 @@ class NativeRecordTrainSource(RecordFileSource):
 
     def load_batch(self, rows: np.ndarray, epoch: int) -> dict:
         payloads, labels = map(
-            list, zip(*(self.read_record_tolerant(int(i)) for i in rows))
+            list, zip(*(self.read_record_tolerant(int(i)) for i in rows), strict=True)
         )
         if self.train and self.aug == "rrc":
             images = self._produce_batch_tolerant(

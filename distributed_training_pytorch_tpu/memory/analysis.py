@@ -46,6 +46,12 @@ from typing import Any, Mapping, Sequence
 
 import jax
 
+# The one chain-window stacking rule lives on the engine now, shared with
+# the HLO and comm audits (explicit re-export: existing importers of
+# memory.analysis keep working).
+from distributed_training_pytorch_tpu.train.engine import (
+    stack_chain_batch as stack_chain_batch,
+)
 from distributed_training_pytorch_tpu.utils.hlo_flops import DTYPE_BYTES, aval_bytes
 
 __all__ = [
@@ -297,14 +303,6 @@ def _abstract_tree(tree) -> Any:
     )
 
 
-def stack_chain_batch(batch, chain_length: int) -> Any:
-    """The chain-stacked abstract window for a per-step batch: every leaf
-    gains a leading ``chain_length`` axis (the ``device_prefetch_chained``
-    staging layout the chained program consumes)."""
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct((int(chain_length),) + tuple(x.shape), x.dtype),
-        batch,
-    )
 
 
 def analyze_step_memory(

@@ -85,7 +85,7 @@ class ConvNeXt(nn.Module):
         total_blocks = sum(self.depths)
         rates = np.linspace(0.0, self.drop_path_rate, total_blocks)  # static schedule
         block = 0
-        for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+        for stage, (depth, dim) in enumerate(zip(self.depths, self.dims, strict=True)):
             if stage > 0:
                 x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(x)
                 x = nn.Conv(dim, (2, 2), strides=(2, 2), dtype=self.dtype)(x)

@@ -93,7 +93,7 @@ class VGG16(nn.Module):
                 f"({len(self.stage_features)} 2x2 max-pools), got {x.shape[1]}x{x.shape[2]}"
             )
         x = x.astype(self.dtype)
-        for feats, layers in zip(self.stage_features, self.stage_layers):
+        for feats, layers in zip(self.stage_features, self.stage_layers, strict=True):
             x = ConvBlock(feats, layers, dtype=self.dtype)(x)
         x = adaptive_avg_pool_2d(x, (7, 7))
         x = x.reshape(x.shape[0], -1)

@@ -181,6 +181,19 @@ def chain_batch_sharding(mesh: Mesh, batch_axes: Sequence[str] | None = None) ->
     return NamedSharding(mesh, spec)
 
 
+def device_coords(mesh: Mesh) -> "dict[int, tuple[int, ...]]":
+    """Map global device id -> this mesh's axis coordinates (one tuple per
+    axis in ``mesh.axis_names`` order). Replica groups in compiled HLO name
+    devices by their global ids (``use_global_device_ids``); this map is how
+    ``analysis.comm_audit`` attributes a collective's device groups back to
+    the mesh axes they span — robust to ``mesh_utils`` device reorderings
+    because it reads positions off ``mesh.devices`` itself."""
+    coords: dict[int, tuple[int, ...]] = {}
+    for idx in np.ndindex(mesh.devices.shape):
+        coords[int(mesh.devices[idx].id)] = tuple(int(i) for i in idx)
+    return coords
+
+
 def batch_shard_extent(mesh: Mesh) -> int:
     """How many ways the batch dimension is sharded on ``mesh`` — the
     product of the batch-like axes present (``data`` x ``fsdp``, the axes

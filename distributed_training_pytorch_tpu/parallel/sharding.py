@@ -45,7 +45,9 @@ def _spec_fits(spec: P, shape: tuple[int, ...], mesh: Mesh) -> bool:
     corresponding array dimension."""
     if len(spec) > len(shape):
         return False
-    for dim, names in zip(shape, spec):
+    # strict=False: a spec legally names fewer dims than the array has
+    # (trailing dims replicated) — truncation here is the contract.
+    for dim, names in zip(shape, spec, strict=False):
         if names is None:
             continue
         names = names if isinstance(names, tuple) else (names,)
@@ -78,19 +80,22 @@ def _fsdp_spec(shape: tuple[int, ...], mesh: Mesh, axis: str, min_size: int) -> 
     return P()
 
 
-def spec_for_leaf(
+def rule_for_leaf(
     path: str,
     shape: tuple[int, ...],
     mesh: Mesh,
     rules: Sequence[Rule] = (),
-    *,
-    fsdp_axis: str = FSDP_AXIS,
-    fsdp_min_size: int = 2**18,
-) -> P:
+) -> "tuple[str, P] | None":
+    """The ``(pattern, spec)`` of the first explicit rule that matched AND
+    fits this leaf, or None when the leaf takes the FSDP/replicated fallback.
+    Split out of :func:`spec_for_leaf` so consumers that need *attribution*
+    — ``analysis.comm_audit`` traces an accidental full-param gather back to
+    the rule that sharded the leaf — resolve rules by exactly the dispatch
+    path's matching order."""
     for pattern, spec in rules:
         if re.search(pattern, path):
             if _spec_fits(spec, shape, mesh):
-                return spec
+                return pattern, spec
             # An explicit rule that matched but doesn't divide the array is
             # almost always a config mistake (e.g. heads % tensor != 0) that
             # would otherwise silently disable TP — say so loudly.
@@ -100,6 +105,21 @@ def spec_for_leaf(
                 pattern, path, shape, spec, dict(mesh.shape),
             )
             break
+    return None
+
+
+def spec_for_leaf(
+    path: str,
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    rules: Sequence[Rule] = (),
+    *,
+    fsdp_axis: str = FSDP_AXIS,
+    fsdp_min_size: int = 2**18,
+) -> P:
+    matched = rule_for_leaf(path, shape, mesh, rules)
+    if matched is not None:
+        return matched[1]
     return _fsdp_spec(shape, mesh, fsdp_axis, fsdp_min_size)
 
 

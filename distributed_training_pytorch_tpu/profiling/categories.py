@@ -55,7 +55,17 @@ def categorize(name: str) -> str:
         return "pool-backward"
     if "reduce_window" in head or "reduce-window" in head:
         return "pool-forward"
-    if "all-reduce" in head or "all-gather" in head or "reduce-scatter" in head:
+    if (
+        "all-reduce" in head
+        or "all-gather" in head
+        or "reduce-scatter" in head
+        or "collective-permute" in head
+        or "all-to-all" in head
+    ):
+        # The full cross-chip family: permutes and all-to-alls are how SPMD
+        # lowers resharding moves (measured on the fsdp audit programs) —
+        # before ISSUE 11 they leaked into `other`, hiding comm time from
+        # profile reports and comm bytes from the audit's category join.
         return "collective"
     if "infeed" in head or "outfeed" in head:
         return "infeed"

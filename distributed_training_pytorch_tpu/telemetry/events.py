@@ -13,6 +13,7 @@ verdicts (``perf_gate``: measured vs baseline, tolerance, verdict, emitted
 by ``scripts/perf_gate.py``), static-audit verdicts (``static_audit``:
 per-rule lint counts, waiver counts, undonated param/opt-state bytes of
 the single-step and chained programs, precision leaks, host callbacks,
+per-mesh comm bytes + comm-audit findings and gate verdicts,
 emitted by ``scripts/static_audit.py --events``), memory-preflight
 verdicts (``memory_preflight``: predicted peak vs capacity, per-class
 attribution, batch/microbatch/fsdp recommendations, emitted by

@@ -3,4 +3,5 @@ from distributed_training_pytorch_tpu.train.engine import (  # noqa: F401
     NonFiniteLossError,
     TrainEngine,
     make_supervised_loss,
+    stack_chain_batch,
 )

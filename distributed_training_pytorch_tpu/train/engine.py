@@ -54,6 +54,21 @@ class NonFiniteLossError(FloatingPointError):
     non-finite loss (the functional analog of torch's anomaly detection)."""
 
 
+def stack_chain_batch(batch, chain_length: int) -> Any:
+    """The chain-stacked abstract window for a per-step batch: every leaf
+    gains a leading ``chain_length`` axis (the ``device_prefetch_chained``
+    staging layout the chained program consumes). The ONE stacking rule for
+    every observability probe of the chained program — memory attribution
+    (``memory.analysis``), the donation audit (``analysis.hlo_audit``), and
+    the communication audit (``analysis.comm_audit``) all build the probe
+    window here, so the audited window shape cannot drift from the shape
+    :meth:`TrainEngine.train_steps_chained` dispatches."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((int(chain_length),) + tuple(x.shape), x.dtype),
+        batch,
+    )
+
+
 def make_supervised_loss(model, criterion: Callable) -> LossFn:
     """Build the standard supervised LossFn from a Flax module + criterion.
 

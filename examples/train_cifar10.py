@@ -46,7 +46,7 @@ def load_cifar10(data_dir: str):
             y = np.asarray(d[b"labels"], np.int32)
             return x, y
 
-        xs, ys = zip(*(read(f"data_batch_{i}") for i in range(1, 6)))
+        xs, ys = zip(*(read(f"data_batch_{i}") for i in range(1, 6)), strict=True)
         test_x, test_y = read("test_batch")
         return np.concatenate(xs), np.concatenate(ys), test_x, test_y
     print(f"WARNING: {data_dir} not found — using synthetic CIFAR-shaped data")

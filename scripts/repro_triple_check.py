@@ -28,21 +28,16 @@ can be re-evaluated):  python scripts/repro_triple_check.py
 import os
 import sys
 
-sys.path.insert(0, ".")
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_pytorch_tpu import compat  # noqa: E402
+
+compat.force_host_devices(8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
-
-from distributed_training_pytorch_tpu import compat
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.parallel.moe import MoEMlp
 from distributed_training_pytorch_tpu.parallel.pipeline import (

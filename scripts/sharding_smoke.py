@@ -36,13 +36,11 @@ Runs in ~2 minutes on CPU; wired as a verify.sh stage.
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_pytorch_tpu import compat  # noqa: E402
+
+compat.force_host_devices(8)
 
 import tempfile  # noqa: E402
 
@@ -80,7 +78,7 @@ def ok(cond, msg):
 def params_equal(a, b):
     return all(
         np.array_equal(np.asarray(x), np.asarray(y))
-        for x, y in zip(jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b)))
+        for x, y in zip(jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b)), strict=True)
     )
 
 
@@ -143,7 +141,7 @@ def stage_engine_parity():
        "data=2/fsdp=2/tensor=2 sharded INIT is bit-exact with replicated init")
     ok(mix_losses[0] == dp_losses[0],
        "data=2/fsdp=2/tensor=2 first-step loss bit-exact with DP")
-    worst = max(abs(a - b) for a, b in zip(mix_losses, dp_losses))
+    worst = max(abs(a - b) for a, b in zip(mix_losses, dp_losses, strict=True))
     ok(worst <= 5e-6,
        f"data=2/fsdp=2/tensor=2 losses match DP to ULP tolerance (worst {worst:.2e})")
     specs = [

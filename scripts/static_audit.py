@@ -1,19 +1,19 @@
 #!/usr/bin/env python
-"""Static audit gate — ruff/generic + jaxlint + compiled-program HLO audit.
+"""Static audit gate — ruff/generic + jaxlint + HLO audit + comm audit.
 
 Six PRs of reliability work fixed the same bug classes after the fact:
 cross-thread mutation without a lock (PR 5's EventLog t_mono fix), host
 syncs sneaking into the hot path, rank-0 file-ownership violations,
 undonated device buffers (ROADMAP item 3). This gate makes those invariants
 machine-checked (ISSUE 7; rule catalog and history in
-docs/static_analysis.md). Three passes, strictest-first cheap-first:
+docs/static_analysis.md). Four passes, strictest-first cheap-first:
 
 1. **generic** (``analysis.generic``): ruff with the repo's
    ``[tool.ruff]`` config when installed; a stdlib fallback (syntax +
    unused-import) in hermetic environments. jaxlint deliberately carries
    NO generic rules — this layer owns them.
-2. **jaxlint** (``analysis.lint``): the six project rules over the package
-   source. Findings are fatal unless waived inline
+2. **jaxlint** (``analysis.lint``): the seven project rules over the
+   package source. Findings are fatal unless waived inline
    (``# jaxlint: disable=<rule> -- <reason>``); every waiver in effect is
    printed so the exception list is reviewed on every run.
 3. **HLO audit** (``analysis.hlo_audit``): lowers the REAL single-step and
@@ -21,39 +21,51 @@ docs/static_analysis.md). Three passes, strictest-first cheap-first:
    and verifies 100% of param/optimizer-state input bytes are donated, a
    bf16 program leaks no fp32 dot/conv, and the chained program contains
    no host callbacks.
+4. **comm audit** (``analysis.comm_audit``, ISSUE 11): inventories every
+   collective of the SPMD-partitioned single-step AND chained programs on
+   the dp8/fsdp8/tp2x4/dp2fsdp2tp2 meshes (byte volume + mesh-axis
+   attribution), checks them against the analytic expected-comm model
+   (accidental full-param gathers on the tensor axis; totals past the
+   model's bound), and gates per-mesh totals against the committed
+   ``COMM_BASELINE.json`` — the perf gate's one-rule/--update/stale-nudge
+   ritual applied to communication bytes.
 
-Self-test seam (the perf gate's ``--inject-slowdown`` analog):
+Self-test seams (the perf gate's ``--inject-slowdown`` analog):
 ``--inject-violation lint`` lints a synthetic module with one violation of
 every rule merged into the real run; ``--inject-violation hlo`` audits the
-probes lowered WITHOUT donation. Both must make this gate FAIL —
-verify.sh asserts it, so the gate's teeth are themselves tested on every
-run.
+probes lowered WITHOUT donation; ``--inject-violation comm`` audits a
+deliberately mis-ruled TP spec whose optimizer update must all-gather the
+full parameter every step. Each must make this gate FAIL — verify.sh
+asserts all three, so the gate's teeth are themselves tested on every run.
+
+``--update-comm-baseline`` is the comm twin of ``perf_gate.py --update``:
+re-measure every audited mesh and rewrite ``COMM_BASELINE.json`` (refused
+while injecting — a baseline must never memorialize a mis-ruled program).
 
 ``--events PATH`` appends a ``static_audit`` record to a telemetry JSONL
-log (rule counts, waiver counts, undonated bytes) so audit results are
-greppable next to ``perf_gate`` records.
+log (rule counts, waiver counts, undonated bytes, per-mesh comm bytes) so
+audit results are greppable next to ``perf_gate`` records.
 
 Exit codes: 0 clean, 1 generic findings, 2 jaxlint findings, 3 HLO audit
-violations (first failing pass wins).
+violations, 4 comm audit violations (first failing pass wins).
 """
 
 import argparse
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# 8 virtual host devices (the tests/conftest.py convention) so the HLO
-# audit's SPMD pass — donation + precision on a data=2/fsdp=2/tensor=2
-# mesh with genuinely sharded state — always runs in the verify gate, not
-# only under pytest. Must happen before jax first initializes its CPU
-# client; appended (not overwritten) so caller-supplied XLA_FLAGS survive.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
+
+# 8 virtual host devices (the tests/conftest.py convention, via the shared
+# compat helper) so the HLO audit's SPMD pass and the comm audit — donation
+# + precision + collective inventories over data/fsdp/tensor meshes with
+# genuinely sharded state — always run in the verify gate, not only under
+# pytest. Must happen before jax first initializes its CPU client; the
+# helper appends (never overwrites) caller-supplied XLA_FLAGS.
+from distributed_training_pytorch_tpu import compat  # noqa: E402
+
+compat.force_host_devices(8)
 
 # Paths are anchored to the repo root (NOT the cwd): run from anywhere, the
 # gate scans the same tree — a cwd-relative scan that finds zero files would
@@ -68,7 +80,7 @@ GENERIC_PATHS = [PACKAGE] + [
 ]
 LINT_PATHS = [PACKAGE]
 
-# One violation of every jaxlint rule, in ~20 lines — the lint self-test
+# One violation of every jaxlint rule, in ~25 lines — the lint self-test
 # fixture. If a rule rewrite stops catching its class of bug, the injection
 # run passes and verify.sh fails the build.
 INJECTED_LINT_SNIPPET = '''\
@@ -87,6 +99,10 @@ def train_step(state, batch):
 
 
 stepped = jax.jit(train_step)               # missing-donate-on-jit
+
+
+def leaf_pairs(a, b):
+    return list(zip(jax.tree.leaves(a), jax.tree.leaves(b)))  # zip-no-strict
 
 
 def write_log(line):
@@ -114,11 +130,11 @@ def run_generic_pass() -> tuple[int, dict]:
 
     paths = [p for p in GENERIC_PATHS if os.path.exists(p)]
     if not paths:
-        print(f"static_audit: [1/3] generic: NO scan paths exist under "
+        print(f"static_audit: [1/4] generic: NO scan paths exist under "
               f"{REPO_ROOT} — refusing a vacuous pass")
         return 1, {"generic_tool": "none", "generic_findings": 1}
     report = run_generic(paths)
-    print(f"static_audit: [1/3] generic ({report.tool}): "
+    print(f"static_audit: [1/4] generic ({report.tool}): "
           f"{len(report.findings)} finding(s)")
     for finding in report.findings:
         print("  " + finding.describe())
@@ -126,15 +142,15 @@ def run_generic_pass() -> tuple[int, dict]:
                                   "generic_findings": len(report.findings)}
 
 
-def run_lint_pass(inject: bool) -> tuple[int, dict]:
+def run_lint_pass(inject: bool, lint_paths_override=None) -> tuple[int, dict]:
     from distributed_training_pytorch_tpu.analysis.lint import (
         lint_paths,
         lint_source,
     )
 
-    paths = [p for p in LINT_PATHS if os.path.exists(p)]
+    paths = [p for p in (lint_paths_override or LINT_PATHS) if os.path.exists(p)]
     if not paths:
-        print("static_audit: [2/3] jaxlint: NO scan paths exist — refusing "
+        print("static_audit: [2/4] jaxlint: NO scan paths exist — refusing "
               "a vacuous pass")
         return 1, {"lint_findings": 1, "lint_waived": 0, "lint_rule_counts": {}}
     result = lint_paths(paths)
@@ -146,7 +162,7 @@ def run_lint_pass(inject: bool) -> tuple[int, dict]:
               "violating every jaxlint rule (this gate must fail)")
     unwaived = result.unwaived
     counts = result.counts()
-    print(f"static_audit: [2/3] jaxlint: {len(unwaived)} unwaived finding(s), "
+    print(f"static_audit: [2/4] jaxlint: {len(unwaived)} unwaived finding(s), "
           f"{len(result.waived)} waived, rule counts: "
           + (str(counts) if counts else "{}"))
     for finding in unwaived:
@@ -161,6 +177,7 @@ def run_lint_pass(inject: bool) -> tuple[int, dict]:
         "lint_findings": len(unwaived),
         "lint_waived": len(result.waived),
         "lint_rule_counts": counts,
+        "lint_unused_waivers": len(result.unused_waivers),
     }
     return len(unwaived), fields
 
@@ -172,25 +189,88 @@ def run_hlo_pass(inject: bool, chain_steps: int) -> tuple[int, dict]:
         print("static_audit: SELF-TEST — auditing probes lowered WITHOUT "
               "donation (this gate must fail)")
     report = run_hlo_audit(chain_steps=chain_steps, inject_violation=inject)
-    print(f"static_audit: [3/3] HLO audit (chain_steps={chain_steps}):")
+    print(f"static_audit: [3/4] HLO audit (chain_steps={chain_steps}):")
     print(report.describe())
     return (0 if report.ok else 1), report.to_fields()
+
+
+def run_comm_pass(inject: bool, chain_steps: int) -> tuple[int, dict]:
+    from distributed_training_pytorch_tpu.analysis.comm_audit import (
+        COMM_BASELINE_PATH,
+        load_comm_baseline,
+        run_comm_audit,
+    )
+
+    if inject:
+        print("static_audit: SELF-TEST — auditing a deliberately MIS-RULED "
+              "TP spec (full-param all-gather; this gate must fail)")
+    try:
+        baseline = load_comm_baseline()
+    except FileNotFoundError:
+        baseline = None
+        print(f"static_audit: [4/4] comm audit: NO {COMM_BASELINE_PATH} — "
+              "record one with --update-comm-baseline")
+    except ValueError as e:  # torn/malformed file: the --update ritual is
+        baseline = None      # the documented recovery (perf-gate contract)
+        print(f"static_audit: [4/4] comm audit: MALFORMED baseline ({e}) — "
+              "re-record with --update-comm-baseline")
+    report = run_comm_audit(
+        chain_steps=chain_steps, inject_violation=inject, baseline=baseline
+    )
+    print(f"static_audit: [4/4] comm audit (chain_steps={chain_steps}):")
+    print(report.describe())
+    bad = 0 if report.ok else 1
+    if baseline is None and report.skipped is None:
+        bad = 1  # measured fine, but an ungated audit is not a gate
+    return bad, report.to_fields()
+
+
+def update_comm_baseline(chain_steps: int) -> int:
+    from distributed_training_pytorch_tpu.analysis.comm_audit import (
+        COMM_BASELINE_PATH,
+        record_comm_baseline,
+    )
+
+    try:
+        report = record_comm_baseline(chain_steps=chain_steps)
+    except ValueError as e:
+        print(f"static_audit: --update-comm-baseline REFUSED — {e}")
+        return 4
+    print(report.describe())
+    print(f"static_audit: recorded {len(report.specs)} comm baseline "
+          f"entries -> {COMM_BASELINE_PATH}")
+    return 0
 
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
-        "--inject-violation", choices=("lint", "hlo"), default=None,
+        "--inject-violation", choices=("lint", "hlo", "comm"), default=None,
         help="self-test seam: make the named pass audit a known-bad input; "
              "the gate must exit non-zero (verify.sh asserts it)")
     parser.add_argument(
         "--chain-steps", type=int, default=4,
-        help="window length of the chained program the HLO audit lowers")
+        help="window length of the chained programs the HLO/comm audits lower")
     parser.add_argument(
         "--skip-hlo", action="store_true",
-        help="source passes only — skips the XLA lowerings/compiles (jax "
-             "itself still imports via the package): the fast path for "
-             "editor/pre-commit hooks; verify.sh always runs the full gate")
+        help="skip the HLO (donation/precision/callback) pass; combine with "
+             "--skip-comm for the source-only fast path editor/pre-commit "
+             "hooks want — verify.sh always runs the full gate, and its "
+             "injection self-tests use the skips to pay only for the pass "
+             "they target")
+    parser.add_argument(
+        "--skip-comm", action="store_true",
+        help="skip the comm audit (verify.sh uses this on the hlo-injection "
+             "self-test run, whose target is the donation pass)")
+    parser.add_argument(
+        "--update-comm-baseline", action="store_true",
+        help="re-measure every audited mesh and rewrite COMM_BASELINE.json "
+             "(the perf gate's --update ritual for comm bytes); runs ONLY "
+             "the comm measurement, refuses under --inject-violation")
+    parser.add_argument(
+        "--lint-path", action="append", default=None, metavar="PATH",
+        help="override the jaxlint scan roots (repeatable) — the seam the "
+             "CLI tests use to lint a known tree; default is the package")
     parser.add_argument(
         "--events", default=None,
         help="append a static_audit record to this JSONL event log")
@@ -201,13 +281,24 @@ def main() -> int:
         # PASS having verified nothing.
         parser.error("--inject-violation hlo requires the HLO pass; "
                      "drop --skip-hlo")
+    if args.skip_comm and args.inject_violation == "comm":
+        parser.error("--inject-violation comm requires the comm pass; "
+                     "drop --skip-comm")
+    if args.update_comm_baseline and args.inject_violation:
+        parser.error("--update-comm-baseline must not record an injected "
+                     "violation; drop --inject-violation")
+    if args.update_comm_baseline:
+        return update_comm_baseline(args.chain_steps)
 
     fields: dict = {"injected": args.inject_violation}
     generic_count, f = run_generic_pass()
     fields.update(f)
-    lint_count, f = run_lint_pass(inject=args.inject_violation == "lint")
+    lint_count, f = run_lint_pass(
+        inject=args.inject_violation == "lint",
+        lint_paths_override=args.lint_path,
+    )
     fields.update(f)
-    hlo_bad = 0
+    hlo_bad = comm_bad = 0
     if not args.skip_hlo:
         try:
             hlo_bad, f = run_hlo_pass(
@@ -216,11 +307,25 @@ def main() -> int:
             )
             fields.update(f)
         except Exception as e:  # audit infrastructure failure, not a finding
-            print(f"static_audit: [3/3] HLO audit ERROR — {type(e).__name__}: "
+            print(f"static_audit: [3/4] HLO audit ERROR — {type(e).__name__}: "
                   f"{e}\n  (audit infrastructure failure: the lowering or the "
                   "leaf->parameter mapping broke, not a lintable finding)")
             hlo_bad = 1
             fields["hlo_error"] = f"{type(e).__name__}: {e}"
+    if not args.skip_comm:
+        try:
+            comm_bad, f = run_comm_pass(
+                inject=args.inject_violation == "comm",
+                chain_steps=args.chain_steps,
+            )
+            fields.update(f)
+        except Exception as e:  # same contract as the HLO pass
+            print(f"static_audit: [4/4] comm audit ERROR — "
+                  f"{type(e).__name__}: {e}\n  (audit infrastructure "
+                  "failure: the inventory parse or the model broke, not "
+                  "a comm finding)")
+            comm_bad = 1
+            fields["comm_error"] = f"{type(e).__name__}: {e}"
 
     if generic_count:
         rc = 1
@@ -228,6 +333,8 @@ def main() -> int:
         rc = 2
     elif hlo_bad:
         rc = 3
+    elif comm_bad:
+        rc = 4
     else:
         rc = 0
     fields["passed"] = rc == 0

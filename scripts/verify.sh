@@ -8,18 +8,23 @@
 # breakages went unnoticed.
 #
 # Stage 2 is the static audit (docs/static_analysis.md): generic lint (ruff
-# or the stdlib fallback), jaxlint's six project rules (host syncs in
+# or the stdlib fallback), jaxlint's seven project rules (host syncs in
 # compiled regions, un-rank-gated writes, unlocked cross-thread mutation,
-# wall-clock in jitted code, bare excepts, undonated state jits — every
-# waiver printed with its reason), and the compiled-program HLO audit
-# (100% param/opt-state donation on the real single-step AND chained
-# programs, no fp32 dot/conv under bf16, no host callbacks in the chained
-# window). The audit runs on 8 forced-host devices so the same donation +
-# precision invariants are ALSO verified on SPMD-partitioned programs over
-# a data=2/fsdp=2/tensor=2 mesh with genuinely sharded state (ISSUE 10).
-# The gate's teeth are tested on every run: an injected lint violation and
-# an injected undonated lowering (sharded programs included) must each
-# make it FAIL.
+# wall-clock in jitted code, bare excepts, undonated state jits, unstrict
+# pytree-leaf zips — every waiver printed with its reason), the
+# compiled-program HLO audit (100% param/opt-state donation on the real
+# single-step AND chained programs, no fp32 dot/conv under bf16, no host
+# callbacks in the chained window), and the SPMD communication audit
+# (ISSUE 11): a collective inventory of the partitioned dp8/fsdp8/tp2x4/
+# dp2fsdp2tp2 single-step and chained programs checked against the analytic
+# expected-comm model (no accidental full-param gathers on the tensor axis,
+# totals within the model's bound) and gated against COMM_BASELINE.json
+# exactly like the perf gate. The audits run on 8 forced-host devices so
+# donation + precision + collectives are all verified on genuinely sharded
+# SPMD programs (ISSUE 10/11). The gate's teeth are tested on every run:
+# an injected lint violation, an injected undonated lowering (sharded
+# programs included), and an injected mis-ruled TP spec (whose optimizer
+# update must all-gather the full parameter) must each make it FAIL.
 #
 # Stage 3 is a ~8s CPU run through the real chained Trainer hot path
 # asserting (via the engine's compilation counters) that the chained
@@ -82,23 +87,31 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/10: static audit (generic + jaxlint + HLO) =="
+echo "== stage 2/10: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
-  echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md)"
+  echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
+  echo " comm-baseline drift? re-record: scripts/static_audit.py --update-comm-baseline)"
   exit 3
 fi
-if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation lint --skip-hlo \
+# Each injection run skips the passes it does not target (they already ran
+# clean above) — the self-tests pay only for the pass under test.
+if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation lint --skip-hlo --skip-comm \
     > /tmp/_audit_selftest.log 2>&1; then
   echo "STATIC AUDIT SELF-TEST FAILED — injected lint violations PASSED the gate"
   exit 3
 fi
-if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation hlo \
+if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation hlo --skip-comm \
     > /tmp/_audit_selftest.log 2>&1; then
   echo "STATIC AUDIT SELF-TEST FAILED — an undonated program PASSED the HLO audit"
   exit 3
 fi
-echo "static_audit self-tests OK: injected lint + donation violations correctly failed"
+if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --skip-hlo \
+    > /tmp/_audit_selftest.log 2>&1; then
+  echo "STATIC AUDIT SELF-TEST FAILED — a mis-ruled TP spec (full-param all-gather) PASSED the comm audit"
+  exit 3
+fi
+echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
 echo "== stage 3/10: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then

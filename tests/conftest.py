@@ -2,23 +2,20 @@
 
 SURVEY.md §4: multi-device semantics are tested without a pod via
 ``--xla_force_host_platform_device_count=8`` — real Mesh/jit/collective paths,
-no TPU required. The environment may pre-import jax with a TPU plugin
-registered (sitecustomize), so we both set the env vars AND flip
-``jax_platforms`` via config post-import; the CPU client reads XLA_FLAGS at
-its own first initialization, which has not happened yet.
+no TPU required. The setup lives in ``compat.force_host_devices`` (ISSUE 11
+satellite: one implementation shared with ``scripts/static_audit.py`` and
+``scripts/sharding_smoke.py``): it sets the env vars AND flips
+``jax_platforms`` via config post-import, because the environment may
+pre-import jax with a TPU plugin registered (sitecustomize) while the CPU
+client reads XLA_FLAGS only at its own first initialization — which has not
+happened yet at conftest import time.
 """
 
-import os
+from distributed_training_pytorch_tpu import compat
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+compat.force_host_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 import pytest  # noqa: E402
 
 

@@ -242,6 +242,57 @@ class TestBareExceptRule:
         assert rules_of(lint_source(src)) == []
 
 
+class TestZipStrictRule:
+    def test_tree_leaf_zip_without_strict_trips_once(self):
+        src = (
+            "import jax\n"
+            "def pair(a, b):\n"
+            "    return list(zip(jax.tree.leaves(a), jax.tree.leaves(b)))\n"
+        )
+        assert rules_of(lint_source(src)) == ["zip-no-strict"]
+
+    def test_leaves_named_iterables_trip(self):
+        # The PR 9 bug shape: pre-flattened leaf lists, zipped lazily.
+        src = (
+            "def pair(leaves_a, leaves_b):\n"
+            "    return list(zip(leaves_a, leaves_b))\n"
+        )
+        assert rules_of(lint_source(src)) == ["zip-no-strict"]
+
+    def test_strict_true_twin_clean(self):
+        src = (
+            "import jax\n"
+            "def pair(a, b):\n"
+            "    return list(zip(jax.tree.leaves(a), jax.tree.leaves(b), "
+            "strict=True))\n"
+        )
+        assert rules_of(lint_source(src)) == []
+
+    def test_strict_false_documents_truncation(self):
+        src = (
+            "import jax\n"
+            "def pair(a, b):\n"
+            "    return list(zip(jax.tree.leaves(a), jax.tree.leaves(b), "
+            "strict=False))\n"
+        )
+        assert rules_of(lint_source(src)) == []
+
+    def test_non_tree_zip_is_generic_layers_business(self):
+        src = (
+            "def pair(xs, ys):\n"
+            "    return list(zip(xs, ys))\n"
+        )
+        assert rules_of(lint_source(src)) == []
+
+    def test_starred_transpose_clean(self):
+        src = (
+            "import jax\n"
+            "def t(rows):\n"
+            "    return list(zip(*(jax.tree.leaves(r) for r in rows)))\n"
+        )
+        assert rules_of(lint_source(src)) == []
+
+
 class TestMissingDonateRule:
     def test_state_jit_without_donate_trips_once(self):
         src = (
@@ -570,7 +621,7 @@ class TestStaticAuditCLI:
 
     def test_source_passes_exit_zero_and_emit_event(self, tmp_path):
         events = tmp_path / "events.jsonl"
-        proc = self._run("--skip-hlo", "--events", str(events))
+        proc = self._run("--skip-hlo", "--skip-comm", "--events", str(events))
         assert proc.returncode == 0, proc.stdout + proc.stderr
         from distributed_training_pytorch_tpu.telemetry import read_events
 
@@ -582,7 +633,7 @@ class TestStaticAuditCLI:
         assert records[0]["lint_waived"] >= 1
 
     def test_injected_lint_violation_fails(self):
-        proc = self._run("--skip-hlo", "--inject-violation", "lint")
+        proc = self._run("--skip-hlo", "--skip-comm", "--inject-violation", "lint")
         assert proc.returncode == 2, proc.stdout + proc.stderr
         # every rule tripped at least once in the synthetic module
         from distributed_training_pytorch_tpu.analysis import RULES
@@ -591,3 +642,42 @@ class TestStaticAuditCLI:
             if rule == "waiver-missing-reason":
                 continue
             assert rule in proc.stdout, f"{rule} not tripped:\n{proc.stdout}"
+
+    def test_unused_waiver_reported_and_still_exits_zero(self, tmp_path):
+        # ISSUE 11 satellite: the CLI's unused-waiver reporting path. A
+        # waiver whose finding is gone is a NOTE (delete-the-comment nudge),
+        # never a failure — via --lint-path, the CLI's lint-a-known-tree
+        # seam (the shipped package can't carry one: self-parity forbids it).
+        mod = tmp_path / "stale.py"
+        mod.write_text(
+            "x = 1  # jaxlint: disable=bare-except -- fixed long ago\n"
+        )
+        proc = self._run("--skip-hlo", "--skip-comm", "--lint-path", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "NOTE unused waiver" in proc.stdout
+        assert f"{mod}:1" in proc.stdout
+        assert "bare-except" in proc.stdout
+
+    def test_waived_finding_printed_with_reason(self, tmp_path):
+        mod = tmp_path / "waived.py"
+        mod.write_text(
+            "def dump(path):\n"
+            "    with open(path, 'w') as f:  "
+            "# jaxlint: disable=file-write-without-rank-gate -- test CLI\n"
+            "        f.write('x')\n"
+        )
+        proc = self._run("--skip-hlo", "--skip-comm", "--lint-path", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "[waived: test CLI]" in proc.stdout
+
+    def test_comm_injection_flag_conflicts_refused_fast(self):
+        # perf_gate discipline: flag conflicts fail BEFORE any work.
+        proc = self._run("--inject-violation", "comm", "--skip-comm")
+        assert proc.returncode == 2
+        assert "requires the comm pass" in proc.stderr
+        proc = self._run("--inject-violation", "hlo", "--skip-hlo")
+        assert proc.returncode == 2
+        assert "requires the HLO pass" in proc.stderr
+        proc = self._run("--update-comm-baseline", "--inject-violation", "lint")
+        assert proc.returncode == 2
+        assert "must not record" in proc.stderr

@@ -55,7 +55,7 @@ def stack_batches(host_batches):
 def assert_trees_equal(a, b):
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     assert len(la) == len(lb)
-    for x, y in zip(la, lb):
+    for x, y in zip(la, lb, strict=True):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
@@ -184,7 +184,7 @@ def test_device_prefetch_chained_units_and_values(devices):
                 flat.append(jax.tree.map(lambda x, i=i: x[i], host))
     plain = [dict(b) for b in loader]
     assert len(flat) == len(plain) == 11
-    for got, want in zip(flat, plain):
+    for got, want in zip(flat, plain, strict=True):
         np.testing.assert_array_equal(got["image"], np.asarray(want["image"]))
         np.testing.assert_array_equal(got["label"], np.asarray(want["label"]))
 
@@ -260,7 +260,7 @@ def test_trainer_chained_bit_exact_params_and_metrics(single_run, chained_run):
     assert_trees_equal(single_run.state.params, chained_run.state.params)
     assert_trees_equal(single_run.state.opt_state, chained_run.state.opt_state)
     assert len(single_run.epoch_metrics) == len(chained_run.epoch_metrics) == 2
-    for ma, mb in zip(single_run.epoch_metrics, chained_run.epoch_metrics):
+    for ma, mb in zip(single_run.epoch_metrics, chained_run.epoch_metrics, strict=True):
         assert set(ma) == set(mb)
         for k in ma:
             assert ma[k] == mb[k], (k, ma, mb)

@@ -58,10 +58,10 @@ def test_round_trip(tmp_path, shared):
     assert epoch == 7
     leaves_a = jax.tree.leaves(state.params)
     leaves_b = jax.tree.leaves(restored.params)
-    for a, b in zip(leaves_a, leaves_b):
+    for a, b in zip(leaves_a, leaves_b, strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # opt_state (momentum buffers) round-trips too.
-    for a, b in zip(jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)):
+    for a, b in zip(jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
 
@@ -177,7 +177,7 @@ def test_params_only_restore_across_prng_impls(tmp_path, shared):
     )
     restored, epoch = mgr.restore("last", target, params_only=True)
     assert epoch == 3
-    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
 
@@ -221,10 +221,10 @@ def _vit_engine(devices, axes, *, rules=None, min_size=2**18, seed=0, steps=0):
 
 
 def _leaves_equal(a_state, b_state, *, opt=True):
-    for a, b in zip(jax.tree.leaves(a_state.params), jax.tree.leaves(b_state.params)):
+    for a, b in zip(jax.tree.leaves(a_state.params), jax.tree.leaves(b_state.params), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     if opt:
-        for a, b in zip(jax.tree.leaves(a_state.opt_state), jax.tree.leaves(b_state.opt_state)):
+        for a, b in zip(jax.tree.leaves(a_state.opt_state), jax.tree.leaves(b_state.opt_state), strict=True):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 

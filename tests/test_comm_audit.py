@@ -1,0 +1,559 @@
+"""SPMD communication audit tests (ISSUE 11; docs/static_analysis.md).
+
+Three layers: the inventory parser (replica-group forms, byte volumes,
+axis mapping — hand-built lines with hand-computed answers), the analytic
+expected-comm model (hand-computed terms on the audit fixture), and the
+end-to-end audit on the REAL partitioned programs — including the
+acceptance criteria that the dp8/fsdp8/tp2x4 single-step and chained
+inventories match hand-computed per-axis byte totals, and that the
+injected mis-ruled TP spec fails with an accidental-gather naming the
+offending collective and the rule it traces to.
+"""
+
+import jax
+import pytest
+
+from distributed_training_pytorch_tpu.analysis.comm_audit import (
+    _MISRULED_TP_RULES,
+    AUDIT_MESH_SPECS,
+    COMM_OPS,
+    CommInventory,
+    audit_comm_spec,
+    collective_inventory,
+    comm_fields,
+    comm_findings,
+    expected_comm,
+    load_comm_baseline,
+    mesh_axes_for_groups,
+    parse_replica_groups,
+    record_comm_baseline,
+    run_comm_audit,
+)
+from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.profiling.categories import categorize
+
+# Hand-computed audit-fixture facts (AuditNet: conv 3->8 3x3 + dense
+# 512->10, f32, fsdp_min_size=128 so both kernels shard, biases do not):
+CONV_KERNEL = 3 * 3 * 3 * 8 * 4  # 864
+CONV_BIAS = 8 * 4  # 32
+DENSE_KERNEL = 512 * 10 * 4  # 20480
+DENSE_BIAS = 10 * 4  # 40
+PARAM_BYTES = CONV_KERNEL + CONV_BIAS + DENSE_KERNEL + DENSE_BIAS  # 21416
+LOSS_SCALAR = 4  # the one metrics all-reduce (f32[] loss)
+CHAIN = 3
+
+
+# ---------------------------------------------------------------------------
+# Parser primitives
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaGroupParsing:
+    def test_iota_plain(self):
+        assert parse_replica_groups("replica_groups=[4,2]<=[8]") == [
+            (0, 1), (2, 3), (4, 5), (6, 7)
+        ]
+
+    def test_iota_one_group(self):
+        assert parse_replica_groups("replica_groups=[1,8]<=[8]") == [
+            (0, 1, 2, 3, 4, 5, 6, 7)
+        ]
+
+    def test_iota_transposed(self):
+        # iota(8).reshape(4,2).T -> rows (0,2,4,6)/(1,3,5,7)
+        assert parse_replica_groups("replica_groups=[2,4]<=[4,2]T(1,0)") == [
+            (0, 2, 4, 6), (1, 3, 5, 7)
+        ]
+
+    def test_explicit(self):
+        assert parse_replica_groups("replica_groups={{0,2},{1,3}}") == [
+            (0, 2), (1, 3)
+        ]
+
+    def test_absent(self):
+        assert parse_replica_groups("channel_id=3, dimensions={0}") is None
+
+
+class TestAxisMapping:
+    # A data=2/tensor=2 mesh over 4 devices: coords (d, t), id = d*2 + t.
+    COORDS = {0: (0, 0), 1: (0, 1), 2: (1, 0), 3: (1, 1)}
+    AXES = ("data", "tensor")
+
+    def test_tensor_groups(self):
+        assert mesh_axes_for_groups([(0, 1), (2, 3)], self.COORDS, self.AXES) == (
+            "tensor",
+        )
+
+    def test_data_groups(self):
+        assert mesh_axes_for_groups([(0, 2), (1, 3)], self.COORDS, self.AXES) == (
+            "data",
+        )
+
+    def test_all_axes(self):
+        assert mesh_axes_for_groups([(0, 1, 2, 3)], self.COORDS, self.AXES) == (
+            "data", "tensor",
+        )
+
+    def test_unknown_device_unmapped(self):
+        assert mesh_axes_for_groups([(0, 9)], self.COORDS, self.AXES) == ()
+
+
+class TestInventoryParsing:
+    def _mesh(self):
+        return mesh_lib.create_mesh({"data": 8})
+
+    def test_all_reduce_volume_and_axis(self):
+        text = (
+            "  %all-reduce.3 = f32[10,512]{1,0} all-reduce(f32[10,512]{1,0} "
+            "%dot.2), channel_id=8, replica_groups=[1,8]<=[8], "
+            "use_global_device_ids=true, to_apply=%add.1.clone\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        assert len(inv.collectives) == 1
+        c = inv.collectives[0]
+        assert c.op == "all-reduce"
+        assert c.bytes == 10 * 512 * 4
+        assert c.axes == ("data",)
+        assert c.groups == 1 and c.group_size == 8
+
+    def test_all_gather_counts_full_output(self):
+        # Gather [3,3,3,1] -> [3,3,3,8]: volume = the FULL gathered tensor.
+        text = (
+            "  %all-gather = f32[3,3,3,8]{2,1,0,3} all-gather(f32[3,3,3,1]"
+            "{2,1,0,3} %bitcast.39), channel_id=1, replica_groups=[1,8]<=[8], "
+            "dimensions={3}, use_global_device_ids=true\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        assert inv.collectives[0].bytes == 3 * 3 * 3 * 8 * 4
+
+    def test_permute_pairs_and_self_pairs(self):
+        text = (
+            "  %collective-permute = f32[4,4]{1,0} collective-permute("
+            "f32[4,4]{1,0} %copy), channel_id=1, "
+            "source_target_pairs={{0,0},{1,2},{2,1},{3,3}}\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        c = inv.collectives[0]
+        assert c.op == "collective-permute"
+        assert c.bytes == 4 * 4 * 4
+        assert c.groups == 2  # the two non-self pairs
+        assert c.axes == ("data",)
+
+    def test_operand_reference_to_collective_not_double_counted(self):
+        # `%all-gather` as an OPERAND of a later op must not parse as a
+        # second collective.
+        text = (
+            "  %all-gather = f32[8]{0} all-gather(f32[1]{0} %x), "
+            "replica_groups=[1,8]<=[8], dimensions={0}\n"
+            "  %fusion = f32[8]{0} fusion(f32[8]{0} %all-gather), kind=kLoop\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        assert len(inv.collectives) == 1
+
+    def test_singleton_groups_skipped(self):
+        text = (
+            "  %all-reduce = f32[8]{0} all-reduce(f32[8]{0} %r), "
+            "replica_groups=[8,1]<=[8], to_apply=%add\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        assert inv.collectives == []
+
+    def test_every_comm_op_joins_the_profiler_collective_bucket(self):
+        # The inventory's category join: ONE categorizer repo-wide.
+        for op in COMM_OPS:
+            assert categorize(op) == "collective", op
+
+    def test_async_start_form_counted_once_at_full_bytes(self):
+        # TPU optimized HLO splits collectives into -start/-done pairs; the
+        # -start carries shapes + groups and counts ONCE, at the largest
+        # single buffer of its (operand, output) tuple — summing the tuple
+        # would double the collective, and missing the spelling entirely
+        # would zero the bench inventory exactly on the target platform.
+        text = (
+            "  %all-gather-start = (f32[64,10]{1,0}, f32[512,10]{1,0}) "
+            "all-gather-start(f32[64,10]{1,0} %p), channel_id=1, "
+            "replica_groups=[1,8]<=[8], dimensions={0}\n"
+            "  %all-gather-done = f32[512,10]{1,0} all-gather-done("
+            "(f32[64,10]{1,0}, f32[512,10]{1,0}) %all-gather-start)\n"
+        )
+        inv = collective_inventory(text, self._mesh())
+        assert len(inv.collectives) == 1
+        c = inv.collectives[0]
+        assert c.op == "all-gather"  # base opcode: by_op/categorize join
+        assert c.bytes == 512 * 10 * 4
+        assert c.axes == ("data",)
+
+
+# ---------------------------------------------------------------------------
+# The analytic model (hand-computed on the audit fixture)
+# ---------------------------------------------------------------------------
+
+
+def _spec_fixture(spec, rules="auto"):
+    from distributed_training_pytorch_tpu.analysis.comm_audit import _spec_engine
+
+    return _spec_engine(spec, rules=rules)
+
+
+class TestExpectedModel:
+    def test_dp8_grad_sync_only(self, devices):
+        engine, state, batch = _spec_fixture("dp8")
+        model = expected_comm(engine, state, batch)
+        assert model.terms["grad_sync"] == PARAM_BYTES
+        assert model.terms["fsdp_gather"] == 0
+        assert model.terms["tp_activations"] == 0
+        assert model.total == PARAM_BYTES
+
+    def test_fsdp8_adds_double_gather_of_sharded_leaves(self, devices):
+        engine, state, batch = _spec_fixture("fsdp8")
+        model = expected_comm(engine, state, batch)
+        assert model.terms["grad_sync"] == PARAM_BYTES
+        # Both kernels shard (>= 128 elements); biases stay replicated.
+        assert model.terms["fsdp_gather"] == 2 * (CONV_KERNEL + DENSE_KERNEL)
+
+    def test_tp2x4_activation_term(self, devices):
+        engine, state, batch = _spec_fixture("tp2x4")
+        model = expected_comm(engine, state, batch)
+        # rows per replica = 64 / (data=4) = 16; dense kernel dims 512+10.
+        assert model.terms["tp_activations"] == 2 * 16 * (512 + 10) * 4
+        tensor_leaves = model.tensor_leaves()
+        assert [leaf["path"] for leaf in tensor_leaves] == [
+            ".params['Dense_0']['kernel']"
+        ]
+        assert tensor_leaves[0]["rule"] is not None
+
+    def test_chain_length_scales_total(self, devices):
+        engine, state, batch = _spec_fixture("dp8")
+        single = expected_comm(engine, state, batch)
+        window = expected_comm(engine, state, batch, chain_length=CHAIN)
+        assert window.total == CHAIN * single.total
+
+
+class TestFindings:
+    def _expected(self, engine_state_batch):
+        return expected_comm(*engine_state_batch)
+
+    def test_accidental_gather_fires_only_on_full_param_gather(self, devices):
+        expected = self._expected(_spec_fixture("tp2x4"))
+        mesh = mesh_lib.mesh_config_from_spec("tp2x4").build(
+            devices=jax.devices()[:8]
+        )
+        small = collective_inventory(
+            "  %all-gather = f32[64,10]{1,0} all-gather(f32[64,5]{1,0} %x), "
+            "replica_groups=[4,2]<=[8], dimensions={1}\n",
+            mesh,
+        )
+        assert comm_findings(small, expected) == []
+        full = collective_inventory(
+            "  %all-gather.2 = f32[512,10]{0,1} all-gather(f32[512,5]{0,1} "
+            "%m), replica_groups=[4,2]<=[8], dimensions={1}\n",
+            mesh,
+        )
+        findings = comm_findings(full, expected)
+        kinds = [f["kind"] for f in findings]
+        assert "accidental-gather" in kinds
+        f = findings[kinds.index("accidental-gather")]
+        assert f["op"] == "%all-gather.2"
+        assert f["leaf"] == ".params['Dense_0']['kernel']"
+        assert f["rule"] is not None
+
+    def test_per_leaf_threshold_catches_smaller_kernel_gather(self, devices):
+        # A full gather of a SMALLER tensor-sharded kernel must fire even
+        # when a bigger tensor-sharded leaf exists (per-leaf thresholds,
+        # not max-leaf), and the finding attributes to the largest leaf the
+        # volume explains.
+        from distributed_training_pytorch_tpu.analysis.comm_audit import (
+            ExpectedComm,
+        )
+
+        mesh = mesh_lib.mesh_config_from_spec("tp2x4").build(
+            devices=jax.devices()[:8]
+        )
+        expected = ExpectedComm(
+            terms={"grad_sync": 1e6},  # ample model headroom: isolate (a)
+            leaves=[
+                {"path": ".params['big']['kernel']", "shape": (512, 40),
+                 "dtype": "float32", "bytes": 512 * 40 * 4,
+                 "axes": ("tensor",), "rule": "big.*kernel"},
+                {"path": ".params['small']['kernel']", "shape": (64, 8),
+                 "dtype": "float32", "bytes": 64 * 8 * 4,
+                 "axes": ("tensor",), "rule": "small.*kernel"},
+            ],
+        )
+        inv = collective_inventory(
+            "  %all-gather.7 = f32[64,8]{1,0} all-gather(f32[64,4]{1,0} %m), "
+            "replica_groups=[4,2]<=[8], dimensions={1}\n",
+            mesh,
+        )
+        findings = comm_findings(inv, expected)
+        assert [f["kind"] for f in findings] == ["accidental-gather"]
+        assert findings[0]["leaf"] == ".params['small']['kernel']"
+        assert findings[0]["rule"] == "small.*kernel"
+
+    def test_bias_sized_gathers_do_not_false_positive(self, devices):
+        # Tensor-sharded BIAS leaves (ndim < 2) are excluded from the
+        # threshold set: activation gathers routinely exceed a bias's full
+        # bytes on a clean program (the baseline gate owns that scale).
+        from distributed_training_pytorch_tpu.analysis.comm_audit import (
+            ExpectedComm,
+        )
+
+        mesh = mesh_lib.mesh_config_from_spec("tp2x4").build(
+            devices=jax.devices()[:8]
+        )
+        expected = ExpectedComm(
+            terms={"grad_sync": 1e6},  # ample model headroom: isolate (a)
+            leaves=[
+                {"path": ".params['d']['bias']", "shape": (8,),
+                 "dtype": "float32", "bytes": 32,
+                 "axes": ("tensor",), "rule": "bias"},
+            ],
+        )
+        inv = collective_inventory(
+            "  %all-gather = f32[64,10]{1,0} all-gather(f32[64,5]{1,0} %x), "
+            "replica_groups=[4,2]<=[8], dimensions={1}\n",
+            mesh,
+        )
+        assert comm_findings(inv, expected) == []
+
+    def test_gather_on_data_axis_never_accidental(self, devices):
+        # The same full-size gather over the DATA axis groups is not the
+        # tensor mis-rule signature (wgrad partial gathers ride batch axes).
+        expected = self._expected(_spec_fixture("tp2x4"))
+        mesh = mesh_lib.mesh_config_from_spec("tp2x4").build(
+            devices=jax.devices()[:8]
+        )
+        inv = collective_inventory(
+            "  %all-gather = f32[512,10]{0,1} all-gather(f32[128,10]{0,1} "
+            "%m), replica_groups=[2,4]<=[4,2]T(1,0), dimensions={0}\n",
+            mesh,
+        )
+        assert comm_findings(inv, expected) == []
+
+    def test_model_exceeded_fires_past_tolerance(self, devices):
+        fixture = _spec_fixture("dp8")
+        expected = self._expected(fixture)
+        mesh = fixture[0].mesh
+        big = int(expected.total * 3) // 4  # one op; x3 total via 3 copies
+        lines = "".join(
+            f"  %all-reduce.{i} = f32[{big // 4}]{{0}} all-reduce("
+            f"f32[{big // 4}]{{0}} %r{i}), replica_groups=[1,8]<=[8], "
+            "to_apply=%add\n"
+            for i in range(4)
+        )
+        inv = collective_inventory(lines, mesh)
+        findings = comm_findings(inv, expected, tolerance=1.0)
+        assert [f["kind"] for f in findings] == ["model-exceeded"]
+        assert comm_findings(inv, expected, tolerance=5.0) == []
+
+
+# ---------------------------------------------------------------------------
+# The real programs (acceptance criteria) — one audit per spec, reused.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dp8_report(devices):
+    return audit_comm_spec("dp8", chain_steps=CHAIN)
+
+
+@pytest.fixture(scope="module")
+def fsdp8_report(devices):
+    return audit_comm_spec("fsdp8", chain_steps=CHAIN)
+
+
+@pytest.fixture(scope="module")
+def tp_report(devices):
+    return audit_comm_spec("tp2x4", chain_steps=CHAIN)
+
+
+@pytest.fixture(scope="module")
+def misruled_report(devices):
+    report = audit_comm_spec(
+        "tp2x4", chain_steps=CHAIN, rules=_MISRULED_TP_RULES, injected=True
+    )
+    return report
+
+
+class TestRealPrograms:
+    def test_dp8_per_axis_total_is_param_bytes_plus_loss_scalar(self, dp8_report):
+        # ISSUE 11 acceptance: the per-axis byte totals match a
+        # hand-computed expectation — pure DP syncs exactly one gradient
+        # per param leaf plus the scalar loss metric, all on `data`.
+        assert dp8_report.ok, dp8_report.describe()
+        by_axes = dp8_report.single.by_axes()
+        assert by_axes == {("data",): PARAM_BYTES + LOSS_SCALAR}
+        assert dp8_report.single.by_op() == {
+            "all-reduce": PARAM_BYTES + LOSS_SCALAR
+        }
+
+    def test_dp8_chained_scales_exactly(self, dp8_report):
+        assert (
+            dp8_report.chained.total_bytes
+            == CHAIN * dp8_report.single.total_bytes
+        )
+
+    def test_fsdp8_gathers_each_sharded_kernel_whole(self, fsdp8_report):
+        # ZeRO-3 signature: one full-size all-gather per fsdp-sharded leaf,
+        # on the fsdp axis — hand-computed byte values.
+        assert fsdp8_report.ok, fsdp8_report.describe()
+        gathers = sorted(
+            c.bytes
+            for c in fsdp8_report.single.collectives
+            if c.op == "all-gather" and c.axes == ("fsdp",)
+        )
+        assert CONV_KERNEL in gathers
+        assert DENSE_KERNEL in gathers
+        # Grad sync still present at full bytes (all-reduce or equivalent).
+        reduces = fsdp8_report.single.by_op()["all-reduce"]
+        assert reduces >= PARAM_BYTES
+
+    def test_fsdp8_chained_scales_exactly(self, fsdp8_report):
+        assert (
+            fsdp8_report.chained.total_bytes
+            == CHAIN * fsdp8_report.single.total_bytes
+        )
+
+    def test_tp2x4_clean_and_tensor_axis_carries_activation_syncs(self, tp_report):
+        assert tp_report.ok, tp_report.describe()
+        by_axes = tp_report.single.by_axes()
+        # dgrad activation all-reduce [16,512] rides the tensor axis...
+        assert by_axes[("tensor",)] >= 16 * 512 * 4
+        # ...but NO all-gather on tensor approaches the kernel's full bytes.
+        assert all(
+            c.bytes < DENSE_KERNEL
+            for c in tp_report.single.collectives
+            if c.op == "all-gather" and "tensor" in c.axes
+        )
+        # wgrad sync of the tensor-sharded kernel rides the data axis at
+        # SHARD bytes (the model's documented over-estimate direction).
+        assert by_axes[("data",)] >= DENSE_KERNEL // 2
+
+    def test_misruled_spec_fails_with_accidental_gather(self, misruled_report):
+        # ISSUE 11 acceptance: the mis-ruled TP spec (rule anchored to
+        # .params only -> replicated momentum twin) produces a full-param
+        # all-gather on the tensor axis and the audit names it.
+        assert not misruled_report.ok
+        kinds = [f["kind"] for f in misruled_report.findings]
+        assert "accidental-gather" in kinds
+        f = misruled_report.findings[kinds.index("accidental-gather")]
+        assert f["bytes"] == DENSE_KERNEL
+        assert "tensor" in f["axes"]
+        assert f["leaf"] == ".params['Dense_0']['kernel']"
+        assert f["rule"] == _MISRULED_TP_RULES[0][0]
+        assert "all-gather" in f["op"]
+
+    def test_misruled_program_really_gathers_the_kernel(self, misruled_report):
+        gathers = [
+            c
+            for c in misruled_report.single.collectives
+            if c.op == "all-gather" and "tensor" in c.axes
+            and c.bytes == DENSE_KERNEL
+        ]
+        assert gathers, misruled_report.single.describe()
+
+    def test_inventory_code_path_shared_with_bench(self, dp8_report, devices):
+        # bench's comm_fields and the gate audit the SAME inventory: the
+        # probe program's fields must reproduce the report's totals.
+        engine, state, batch = _spec_fixture("dp8")
+        compiled = engine.compile_step_probe(state, batch, donate=True)
+        fields = comm_fields(compiled, engine.mesh)
+        assert fields["comm_bytes_per_step"] == int(dp8_report.single.total_bytes)
+        assert fields["comm"]["all-reduce"] == int(dp8_report.single.total_bytes)
+        assert fields["comm_collectives"] == len(dp8_report.single.collectives)
+
+
+# ---------------------------------------------------------------------------
+# Baseline gating (tmp files; the perf-gate ritual on comm bytes)
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineGate:
+    def _baseline_from(self, *reports, tolerance=0.25, scale=1.0):
+        return {
+            "schema": 1,
+            "entries": {
+                r.spec: {
+                    "comm_bytes_per_step": r.single.total_bytes * scale
+                }
+                for r in reports
+            },
+            "tolerance": {r.spec: tolerance for r in reports},
+        }
+
+    def test_parity_passes_and_regression_fails(self, dp8_report):
+        from distributed_training_pytorch_tpu.profiling.gate import check
+
+        baseline = self._baseline_from(dp8_report)
+        entry = baseline["entries"]["dp8"]
+        ok = check(
+            dp8_report.single.total_bytes,
+            entry["comm_bytes_per_step"],
+            0.25,
+            key="dp8",
+            metric="comm_bytes_per_step",
+        )
+        assert ok.passed and not ok.stale
+        regressed = check(
+            dp8_report.single.total_bytes * 1.5,
+            entry["comm_bytes_per_step"],
+            0.25,
+            key="dp8",
+            metric="comm_bytes_per_step",
+        )
+        assert not regressed.passed
+
+    def test_stale_nudge_when_comm_shrinks(self, dp8_report):
+        from distributed_training_pytorch_tpu.profiling.gate import check
+
+        result = check(
+            dp8_report.single.total_bytes,
+            dp8_report.single.total_bytes * 2.0,
+            0.25,
+            key="dp8",
+            metric="comm_bytes_per_step",
+        )
+        assert result.passed and result.stale
+        assert "re-record" in result.describe()
+
+    def test_record_and_reload_roundtrip(self, tmp_path, devices):
+        path = str(tmp_path / "COMM_BASELINE.json")
+        report = record_comm_baseline(path, chain_steps=CHAIN)
+        baseline = load_comm_baseline(path)
+        assert set(baseline["entries"]) == set(AUDIT_MESH_SPECS)
+        for spec_report in report.specs:
+            entry = baseline["entries"][spec_report.spec]
+            assert entry["comm_bytes_per_step"] == round(
+                spec_report.single.total_bytes, 1
+            )
+            assert baseline["tolerance"][spec_report.spec] == 0.25
+
+    def test_committed_baseline_self_parity(self, devices):
+        # The shipped COMM_BASELINE.json gates the shipped programs: the
+        # full audit (the verify.sh clean pass) must come back green.
+        report = run_comm_audit(chain_steps=4, baseline=load_comm_baseline())
+        assert report.skipped is None
+        assert report.ok, report.describe()
+        for spec_report in report.specs:
+            assert spec_report.gate is not None
+            assert spec_report.gate.passed
+
+    def test_missing_entry_is_a_finding(self, dp8_report, devices):
+        report = run_comm_audit(
+            chain_steps=CHAIN,
+            baseline={"schema": 1, "entries": {}, "tolerance": {}},
+        )
+        assert not report.ok
+        kinds = [f["kind"] for s in report.specs for f in s.findings]
+        assert kinds.count("no-baseline") == len(AUDIT_MESH_SPECS)
+
+
+class TestEmptyInventoryEdge:
+    def test_no_comm_expected_and_none_found_is_clean(self, devices):
+        inv = CommInventory(collectives=[], label="empty")
+        engine, state, batch = _spec_fixture("dp8")
+        expected = expected_comm(engine, state, batch)
+        # A DP mesh expects grad syncs; an empty inventory is merely "no
+        # findings" here (the baseline gate is what catches vanishing comm
+        # via its stale/regression rule on totals).
+        assert comm_findings(inv, expected) == []

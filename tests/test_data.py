@@ -154,7 +154,7 @@ def test_loader_threaded_matches_serial(image_root):
     serial = list(ShardedLoader(src, 8, num_workers=0, **kw))
     threaded = list(ShardedLoader(src, 8, num_workers=4, **kw))
     assert len(serial) == len(threaded) == 3
-    for a, b in zip(serial, threaded):
+    for a, b in zip(serial, threaded, strict=True):
         np.testing.assert_array_equal(a["image"], b["image"])
         np.testing.assert_array_equal(a["label"], b["label"])
 

@@ -80,7 +80,7 @@ def test_grad_accum_matches_full_batch(devices):
     for _ in range(3):
         state1, m1 = engine1.train_step(state1, b1)
         state4, m4 = engine4.train_step(state4, b4)
-    for p1, p4 in zip(jax.tree.leaves(state1.params), jax.tree.leaves(state4.params)):
+    for p1, p4 in zip(jax.tree.leaves(state1.params), jax.tree.leaves(state4.params), strict=True):
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p4), rtol=2e-4, atol=2e-5)
 
 
@@ -100,7 +100,7 @@ def test_determinism_same_seed(devices):
     for _ in range(3):
         state_a, _ = engine_a.train_step(state_a, batch)
         state_b, _ = engine_b.train_step(state_b, batch)
-    for pa, pb in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+    for pa, pb in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params), strict=True):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
 
 
@@ -161,5 +161,5 @@ def test_chained_steps_match_sequential(devices):
     state_b, m_b = chained(state_b, bb)
     assert int(state_b.step) == int(state_a.step) == 4
     np.testing.assert_allclose(float(m_b["ce_loss"]), float(m_a["ce_loss"]), rtol=1e-5)
-    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

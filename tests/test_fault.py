@@ -270,7 +270,7 @@ def test_fast_path_batch_decode_tolerance(tmp_path):
     shard = _write_shard(tmp_path)
     src = RecordFileSource(shard, skip_corrupt=True)
     rows = np.arange(4)
-    payloads, labels = map(list, zip(*(src.read_record(int(i)) for i in rows)))
+    payloads, labels = map(list, zip(*(src.read_record(int(i)) for i in rows), strict=True))
     bad_payload = payloads[2]
 
     def produce(pls):
@@ -284,7 +284,7 @@ def test_fast_path_batch_decode_tolerance(tmp_path):
     assert (payloads[2], labels[2]) == src.read_record(3)  # neighbor pair
 
     strict = RecordFileSource(shard)
-    p2, l2 = map(list, zip(*(strict.read_record(int(i)) for i in rows)))
+    p2, l2 = map(list, zip(*(strict.read_record(int(i)) for i in rows), strict=True))
     with pytest.raises(CorruptRecordError):
         strict._produce_batch_tolerant(rows, p2, l2, produce)
 
@@ -387,12 +387,15 @@ def test_sigterm_mid_epoch_resume_is_bit_exact(tmp_path, mesh):
 
     assert int(resumed.state.step) == int(baseline.state.step)
     for a, b in zip(
-        jax.tree.leaves(baseline.state.params), jax.tree.leaves(resumed.state.params)
+        jax.tree.leaves(baseline.state.params),
+        jax.tree.leaves(resumed.state.params),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(
         jax.tree.leaves(baseline.state.opt_state),
         jax.tree.leaves(resumed.state.opt_state),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
@@ -435,12 +438,15 @@ def test_sigterm_resume_crosses_window_boundary_chained(tmp_path, mesh):
 
     assert int(resumed.state.step) == int(baseline.state.step)
     for a, b in zip(
-        jax.tree.leaves(baseline.state.params), jax.tree.leaves(resumed.state.params)
+        jax.tree.leaves(baseline.state.params),
+        jax.tree.leaves(resumed.state.params),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(
         jax.tree.leaves(baseline.state.opt_state),
         jax.tree.leaves(resumed.state.opt_state),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # realignment shape: 2 lead singles (steps 2-3), then ONE chained window

@@ -83,7 +83,7 @@ def _smoke(model, mesh, image_size=32, num_classes=10, has_model_state=False):
         new = jax.device_get(new_state.model_state)
         assert any(
             not np.allclose(a, b)
-            for a, b in zip(jax.tree.leaves(old), jax.tree.leaves(new))
+            for a, b in zip(jax.tree.leaves(old), jax.tree.leaves(new), strict=True)
         ), "batch_stats must update during training"
     return new_state
 

@@ -180,7 +180,7 @@ def test_moe_sort_dispatch_matches_einsum(top_k, num_groups):
 
         g_ein = jax.grad(loss)(variables, m_ein)
         g_sort = jax.grad(loss)(variables, m_sort)
-        for a, b in zip(jax.tree.leaves(g_ein), jax.tree.leaves(g_sort)):
+        for a, b in zip(jax.tree.leaves(g_ein), jax.tree.leaves(g_sort), strict=True):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 
@@ -291,7 +291,7 @@ def test_manual_expert_mlp_matches_gspmd_path(devices):
                 variables["params"]
             )
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
-        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_man)):
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_man), strict=True):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 

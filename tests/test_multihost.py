@@ -118,7 +118,7 @@ def test_two_process_distributed_train(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for p, out in zip(procs, outs):
+    for p, out in zip(procs, outs, strict=True):
         assert p.returncode == 0, out[-3000:]
     results = {}
     for out in outs:
@@ -292,7 +292,7 @@ def test_two_process_full_trainer(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for p, out in zip(procs, outs):
+    for p, out in zip(procs, outs, strict=True):
         assert p.returncode == 0, out[-4000:]
     results = {}
     for out in outs:
@@ -455,7 +455,7 @@ def test_cross_process_model_parallel_and_sharded_restore(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for p, out in zip(procs, outs):
+    for p, out in zip(procs, outs, strict=True):
         assert p.returncode == 0, out[-4000:]
     results = {}
     for out in outs:

@@ -88,5 +88,5 @@ def test_tied_cross_entropy_matches_naive():
     g_fused = jax.grad(lambda h, e: tied_cross_entropy(h, e, targets, chunk_size=8).sum(),
                        argnums=(0, 1))(hidden, emb)
     g_naive = jax.grad(lambda h, e: naive(h, e).sum(), argnums=(0, 1))(hidden, emb)
-    for a, b in zip(g_fused, g_naive):
+    for a, b in zip(g_fused, g_naive, strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

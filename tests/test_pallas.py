@@ -60,7 +60,7 @@ def test_gradient_parity(b, t, h, d, causal):
 
     grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for gf, gr, name in zip(grads_flash, grads_ref, "qkv"):
+    for gf, gr, name in zip(grads_flash, grads_ref, "qkv", strict=True):
         np.testing.assert_allclose(
             np.asarray(gf), np.asarray(gr), atol=2e-4, err_msg=f"d{name}"
         )
@@ -118,7 +118,7 @@ def test_flash_valid_len_matches_masked_plain():
     g = jnp.asarray(rng.randn(2, t, 4, 8), jnp.float32).at[:, valid:].set(0.0)
     gf = jax.grad(lambda *a: jnp.vdot(f_flash(*a), g), argnums=(0, 1, 2))(q, k, v)
     gp = jax.grad(lambda *a: jnp.vdot(f_plain(*a), g), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(gf, gp):
+    for a, b in zip(gf, gp, strict=True):
         np.testing.assert_allclose(
             np.asarray(a[:, :valid]), np.asarray(b[:, :valid]), atol=3e-5
         )
@@ -146,7 +146,7 @@ def test_vit_pad_seq_to_exact_semantics():
 
     gb = jax.grad(loss)(variables, base)
     gp = jax.grad(loss)(variables, padded)
-    for a, b in zip(jax.tree.leaves(gb), jax.tree.leaves(gp)):
+    for a, b in zip(jax.tree.leaves(gb), jax.tree.leaves(gp), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
@@ -197,7 +197,7 @@ def test_conv1x1_bn_act_diff_gradients():
 
         gp = jax.grad(f, argnums=(0, 1, 2, 3))(x, w, a, b)
         gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, w, a, b)
-        for p, r, name in zip(gp, gr, ("x", "w", "scale", "bias")):
+        for p, r, name in zip(gp, gr, ("x", "w", "scale", "bias"), strict=True):
             np.testing.assert_allclose(
                 np.asarray(p), np.asarray(r), atol=2e-4,
                 err_msg=f"d{name} relu={relu}",

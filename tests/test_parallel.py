@@ -210,7 +210,7 @@ def test_ulysses_flash_matches_plain(devices):
 
     g_plain = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
     g_flash = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g_flash, g_plain):
+    for a, b in zip(g_flash, g_plain, strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
 
 
@@ -237,7 +237,7 @@ def test_ring_flash_gradients_match(seq_mesh, causal):
 
     g_dense = jax.grad(lambda t: loss(t, "dense"))((q, k, v))
     g_flash = jax.grad(lambda t: loss(t, "flash"))((q, k, v))
-    for a, b in zip(g_dense, g_flash):
+    for a, b in zip(g_dense, g_flash, strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 

@@ -70,7 +70,7 @@ def test_pipeline_gradients_match(pipe_mesh):
 
     g_pipe = jax.grad(loss_pipe)(stacked)
     g_ref = jax.grad(loss_ref)(stacked)
-    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref)):
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
@@ -218,7 +218,7 @@ def test_pipeline_interleaved_gradients_match(pipe_mesh):
 
     g_pipe = jax.grad(loss_pipe)(stacked)
     g_ref = jax.grad(loss_ref)(stacked)
-    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref)):
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_ref), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
@@ -235,7 +235,7 @@ def test_pipeline_remat_matches(pipe_mesh):
 
     g_plain = jax.grad(lambda p: loss(p, False))(stacked)
     g_remat = jax.grad(lambda p: loss(p, True))(stacked)
-    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
@@ -406,7 +406,7 @@ def test_pipeline_composes_on_one_mesh(devices, combo):
 
     ref_loss, ref_grads = jax.value_and_grad(seq_loss)(stacked)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
-    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads)):
+    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ref_grads), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 

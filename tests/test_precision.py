@@ -77,7 +77,7 @@ def stack_batches(host_batches):
 def assert_trees_equal(a, b):
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     assert len(la) == len(lb)
-    for x, y in zip(la, lb):
+    for x, y in zip(la, lb, strict=True):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
@@ -300,7 +300,7 @@ def test_fp16_microbatch_accumulation_unscales_once(devices):
     s1, m1 = e1.train_step(s1, e1.shard_batch(b))
     s2, m2 = e2.train_step(s2, e2.shard_batch(b))
     assert float(m1["nonfinite"]) == float(m2["nonfinite"]) == 0.0
-    for a, c in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+    for a, c in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-3)
 
 

@@ -72,7 +72,9 @@ def trees_equal(a, b):
     return all(
         np.array_equal(np.asarray(x), np.asarray(y))
         for x, y in zip(
-            jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+            jax.tree.leaves(jax.device_get(a)),
+            jax.tree.leaves(jax.device_get(b)),
+            strict=True,
         )
     )
 

@@ -531,6 +531,7 @@ class CheckpointManager:
         *,
         params_only: bool = False,
         validate: bool = True,
+        allow_topology_change: bool = False,
     ) -> tuple[Any, int]:
         """Restore ``(state, resume_epoch)`` from a named checkpoint or path.
 
@@ -545,6 +546,17 @@ class CheckpointManager:
 
         ``validate=False`` skips the integrity check (reading a checkpoint
         produced by an external Orbax writer with no manifest).
+
+        A sharded checkpoint whose recorded mesh covers a different device
+        count than this backend raises
+        :class:`~distributed_training_pytorch_tpu.parallel.elastic.
+        TopologyMismatchError` up front, naming both topologies — instead of
+        failing deep inside orbax with no mention of topology.
+        ``allow_topology_change=True`` proceeds (the elastic-restore path:
+        the caller has laid ``target_state`` out for the *current* backend,
+        e.g. via ``parallel.elastic.replan`` — the Trainer does this
+        automatically); the stored global arrays then relay into the
+        target's shardings exactly as any resharding restore does.
 
         Checkpoints written before the crash-consistency upgrade (no
         ``rng_impl`` in meta, rng stored as a key array under ``rest.rng``,
@@ -566,6 +578,30 @@ class CheckpointManager:
         if validate and not has_manifest and not legacy:
             # current-format checkpoint with its manifest gone: torn commit
             self.validate(path)  # raises the canonical no-manifest error
+        # Topology seam (ISSUE 12): a recorded mesh whose device product
+        # disagrees with the backend must fail HERE with names attached —
+        # not as an opaque orbax sharding-deserialization error — unless the
+        # caller explicitly opted into the elastic path. A record-less
+        # checkpoint (pure DP / pre-sharding) has no topology to validate:
+        # its global arrays restore onto any backend.
+        topo_changed = False
+        record = pre_meta.get("sharding")
+        if record:
+            from distributed_training_pytorch_tpu.parallel.elastic import (
+                TopologyMismatchError,
+                validate_topology,
+            )
+
+            try:
+                validate_topology(
+                    record,
+                    jax.device_count(),
+                    name=f"checkpoint {os.path.basename(path)!r}",
+                )
+            except TopologyMismatchError:
+                topo_changed = True
+                if not allow_topology_change:
+                    raise
         # to_shape_dtype_struct preserves each live leaf's NamedSharding, so
         # the restore target's layout — replicated for DP, fsdp/tensor
         # shards otherwise — drives where orbax lays the bytes. That is what
@@ -579,10 +615,15 @@ class CheckpointManager:
             "params": ocp.args.StandardRestore(abstract.params),
             "meta": ocp.args.JsonRestore(),
         }
-        if params_only or legacy:
+        if (params_only and not topo_changed) or legacy:
             # Restore `rest` as stored (no target structure): params_only
             # consumes only its model_state, and a legacy rest tree has a
             # different key layout than the current target would impose.
+            # On a topology-changed restore the as-stored read is the one
+            # path that WOULD die deep in orbax (the stored sharding files
+            # name the writer's devices), so params_only then takes the
+            # targeted branch below — trading the cross-PRNG-impl width
+            # leniency (a same-topology-only concern) for restorability.
             items["rest"] = ocp.args.StandardRestore()
         else:
             # rng is stored as raw key words; recover their aval from the
@@ -611,8 +652,7 @@ class CheckpointManager:
                     "rng_data": rng_data,
                 }
             )
-            items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
-        if not params_only and legacy:
+        if not params_only:
             items["opt_state"] = ocp.args.StandardRestore(abstract.opt_state)
         # Loss-scale state: restored only when BOTH sides speak it — the
         # checkpoint carries a `scale` item AND the target state has scale
@@ -701,18 +741,18 @@ class CheckpointManager:
                 pass  # width mismatch: hand back as stored
         return rng
 
-    def restore_latest_valid(
-        self, target_state: Any, *, params_only: bool = False
-    ) -> tuple[Any, int, str]:
-        """Restore from the newest checkpoint that passes validation.
-
-        Walks committed checkpoints newest-first; a corrupt ``last`` (torn
-        preemption save, bit rot) falls back to the previous good snapshot
-        instead of crashing the resume. Returns ``(state, epoch, name)``;
-        raises :class:`CheckpointError` when nothing valid remains.
-        """
+    def latest_valid_name(self) -> "str | None":
+        """The name ``restore_latest_valid`` would restore — the newest
+        committed checkpoint passing integrity validation, or None when no
+        valid checkpoint exists. Lets consumers (the trainer's elastic-resume
+        peek) inspect the resume checkpoint's meta BEFORE building a restore
+        target, with exactly the fallback-past-corruption choice the real
+        restore will make; rejected checkpoints emit ``checkpoint_rejected``
+        the same way."""
         self.wait()
-        skipped = []
+        return self._latest_valid_name([])
+
+    def _latest_valid_name(self, skipped: list) -> "str | None":
         for name in self.checkpoint_names():
             try:
                 self.validate(name)
@@ -727,16 +767,42 @@ class CheckpointManager:
                         "checkpoint_rejected", name=name, reason=str(e)
                     )
                 continue
-            # validate=False: is_valid just hashed every file; re-validating
-            # inside restore would double the resume path's disk reads.
-            state, epoch = self.restore(
-                name, target_state, params_only=params_only, validate=False
+            return name
+        return None
+
+    def restore_latest_valid(
+        self,
+        target_state: Any,
+        *,
+        params_only: bool = False,
+        allow_topology_change: bool = False,
+    ) -> tuple[Any, int, str]:
+        """Restore from the newest checkpoint that passes validation.
+
+        Walks committed checkpoints newest-first; a corrupt ``last`` (torn
+        preemption save, bit rot) falls back to the previous good snapshot
+        instead of crashing the resume. Returns ``(state, epoch, name)``;
+        raises :class:`CheckpointError` when nothing valid remains.
+        """
+        self.wait()
+        skipped: list = []
+        name = self._latest_valid_name(skipped)
+        if name is None:
+            raise CheckpointError(
+                f"no valid checkpoint under {self.directory} "
+                f"(invalid/corrupt: {skipped or 'none found'})"
             )
-            return state, epoch, name
-        raise CheckpointError(
-            f"no valid checkpoint under {self.directory} "
-            f"(invalid/corrupt: {skipped or 'none found'})"
+        # validate=False: _latest_valid_name just hashed every file;
+        # re-validating inside restore would double the resume path's disk
+        # reads.
+        state, epoch = self.restore(
+            name,
+            target_state,
+            params_only=params_only,
+            validate=False,
+            allow_topology_change=allow_topology_change,
         )
+        return state, epoch, name
 
     def _resolve(self, name_or_path: str) -> str:
         """Name-or-path -> absolute checkpoint dir, with the existence and

@@ -14,6 +14,14 @@ from distributed_training_pytorch_tpu.parallel.mesh import (  # noqa: F401
     is_coordinator,
     global_array_from_host_local,
 )
+from distributed_training_pytorch_tpu.parallel.elastic import (  # noqa: F401
+    ElasticPlan,
+    ElasticReplanError,
+    TopologyMismatchError,
+    replan,
+    replan_accum,
+    validate_topology,
+)
 from distributed_training_pytorch_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,

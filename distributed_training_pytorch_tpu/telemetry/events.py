@@ -21,8 +21,13 @@ attribution, batch/microbatch/fsdp recommendations, emitted by
 resharding restores (``checkpoint_reshard``: a checkpoint whose recorded
 sharding layout differs from the restore target's — mesh axes and sharded
 leaf counts on both sides, emitted by ``CheckpointManager.restore``; the
-DP<->FSDP elasticity path of docs/parallelism.md) — as one JSON object
-per line, machine-readable and append-only.
+DP<->FSDP elasticity path of docs/parallelism.md), and elastic restores
+(``elastic_restore``: a resume that crossed a device-count change — old/new
+mesh axes and device counts, old/new grad-accumulation factors, the re-plan
+reason, and whether the mesh was re-planned or explicitly overridden,
+emitted by the Trainer after a topology-changed restore; the N!=M elastic
+path of docs/fault_tolerance.md) — as one JSON object per line,
+machine-readable and append-only.
 
 Conventions:
 

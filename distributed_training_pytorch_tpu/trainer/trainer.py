@@ -57,6 +57,7 @@ import optax
 from distributed_training_pytorch_tpu.checkpoint import (
     BEST,
     LAST,
+    CheckpointError,
     CheckpointManager,
     epoch_checkpoint_name,
 )
@@ -71,6 +72,7 @@ from distributed_training_pytorch_tpu.memory import (
     run_preflight,
     window_memory_fields,
 )
+from distributed_training_pytorch_tpu.parallel import elastic as elastic_lib
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.precision import (
     get_policy,
@@ -321,6 +323,47 @@ class Trainer:
             self.checkpoints, on_commit=self._on_async_commit
         )
 
+        # Telemetry subsystem (ISSUE 4; docs/observability.md): structured
+        # JSONL event log, goodput wall-time buckets, on-device train-health
+        # stats (threaded into the engine below), per-window MFU, and anomaly
+        # detectors. telemetry=None (default) is the historical program —
+        # self.events is a disabled no-op, self.goodput stays None, and the
+        # engine traces the exact pre-telemetry step. Constructed BEFORE the
+        # mesh so the elastic-resume peek below (which may re-plan the mesh)
+        # reports through the event log; the mesh-dependent peak-FLOPs figure
+        # is finalized right after mesh selection.
+        self.telemetry = resolve_telemetry(telemetry)
+        if self.telemetry is not None:
+            self.events = EventLog(
+                self.telemetry.events_path
+                or os.path.join(save_folder, "telemetry", "events.jsonl")
+            )
+            self.goodput = GoodputMeter() if self.telemetry.goodput else None
+            self.anomaly_detector = self.telemetry.resolve_anomaly()
+            self._flops_per_step = self.telemetry.flops_per_step
+        else:
+            self.events = EventLog(None)
+            self.goodput = None
+            self.anomaly_detector = None
+            self._flops_per_step = None
+        self._peak_flops = 0.0  # finalized after mesh selection below
+        # Recovery skips (restore_latest_valid / the resume peek walking past
+        # a corrupt checkpoint) land in the event log as `checkpoint_rejected`
+        # records.
+        self.checkpoints.event_log = self.events
+
+        # Elastic resume (ISSUE 12; docs/fault_tolerance.md): resolve the
+        # resume checkpoint BEFORE choosing the mesh. A sharded checkpoint
+        # written on a different device count than this backend re-plans the
+        # mesh axes + grad-accumulation for the current topology
+        # (parallel.elastic) when mesh=None — a run killed at fsdp=8 resumes
+        # on 4 or 16 devices without user intervention. Same-topology resumes
+        # (and cold starts) are untouched: the peek is host-side metadata
+        # reading only, and the historical program stays byte-identical.
+        snapshot_path = self._peek_resume_checkpoint(snapshot_path, mesh, batch_size)
+        if self._elastic_plan is not None:
+            mesh = self._elastic_plan.mesh_config.build()
+
         # Mesh — the distributed world (replaces LOCAL_RANK/RANK/WORLD_SIZE
         # env reads + DDP wrap, ``:48-52``). mesh=None is the historical
         # pure-DP program (1-D data mesh over every device, replicated
@@ -345,6 +388,25 @@ class Trainer:
                 "of the data and fsdp axes): every batch shard must hold the "
                 "same number of rows. Round batch_size or re-plan the mesh."
             )
+        # Elastic re-validation (ISSUE 12 satellite): a resumed run on a
+        # re-planned (or hand-picked) mesh can land on a global batch the new
+        # data x fsdp extent x accumulation does not tile — the engine's
+        # microbatch reshape would then fail deep in jax array assembly. Fail
+        # fast here with the ctor-style message instead.
+        if self._topology_changed and batch_size % (
+            self.batch_replicas * self.accum_steps
+        ):
+            suggestion = elastic_lib.nearest_divisible_accum(
+                batch_size, self.batch_replicas, self.accum_steps
+            )
+            raise ValueError(
+                f"global batch_size {batch_size} does not tile into "
+                f"accum_steps={self.accum_steps} microbatches over the "
+                f"resumed mesh's batch-shard extent {self.batch_replicas}: "
+                "every microbatch shard must hold the same number of rows "
+                f"(batch % (extent x accum) != 0). Nearest divisible "
+                f"accum_steps: {suggestion}."
+            )
         self.local_batch_size = batch_size // jax.process_count()
         # Parameter-sharding rules (parallel.sharding): "auto" resolves via
         # the build_sharding_rules hook AFTER build_model runs (the hook may
@@ -363,34 +425,13 @@ class Trainer:
         self._sharding_rules_requested = sharding_rules
         self.fsdp_min_size = int(fsdp_min_size)
 
-        # Telemetry subsystem (ISSUE 4; docs/observability.md): structured
-        # JSONL event log, goodput wall-time buckets, on-device train-health
-        # stats (threaded into the engine below), per-window MFU, and anomaly
-        # detectors. telemetry=None (default) is the historical program —
-        # self.events is a disabled no-op, self.goodput stays None, and the
-        # engine traces the exact pre-telemetry step.
-        self.telemetry = resolve_telemetry(telemetry)
+        # Telemetry's mesh-dependent piece (the subsystem itself was
+        # constructed before mesh selection, for the elastic peek).
         if self.telemetry is not None:
-            self.events = EventLog(
-                self.telemetry.events_path
-                or os.path.join(save_folder, "telemetry", "events.jsonl")
-            )
-            self.goodput = GoodputMeter() if self.telemetry.goodput else None
-            self.anomaly_detector = self.telemetry.resolve_anomaly()
-            self._flops_per_step = self.telemetry.flops_per_step
             self._peak_flops = (
                 telemetry_mfu.device_peak_flops(self.mesh.devices.flat[0])
                 * self.mesh.devices.size
             )
-        else:
-            self.events = EventLog(None)
-            self.goodput = None
-            self.anomaly_detector = None
-            self._flops_per_step = None
-            self._peak_flops = 0.0
-        # Recovery skips (restore_latest_valid walking past a corrupt
-        # checkpoint) land in the event log as `checkpoint_rejected` records.
-        self.checkpoints.event_log = self.events
         # Memory preflight (ISSUE 8; memory/preflight.py): predict the
         # configured program's peak HBM from an abstract lowering BEFORE the
         # first real compile, fail fast on predicted OOM with a batch/
@@ -458,7 +499,9 @@ class Trainer:
             self.build_loss_fn(),
             self.optimizer,
             self.mesh,
-            accum_steps=accum_steps,
+            # self.accum_steps, not the ctor arg: an elastic re-plan may have
+            # re-solved the factor for the new batch-shard extent.
+            accum_steps=self.accum_steps,
             schedule=self.schedule,
             nan_guard=self.nan_policy in ("skip", "restore_last_good"),
             precision=self.precision,
@@ -480,26 +523,30 @@ class Trainer:
         )
         self._log_sharded_layout()
 
-        # Snapshot resume (``:44-45,96-101``). "latest_valid" resolves to the
-        # newest checkpoint that passes integrity validation — the automatic
-        # restart-after-preemption entry point (a torn last save falls back
-        # to the previous good one instead of crashing the resume).
-        if snapshot_path == "latest_valid" and not self.checkpoints.checkpoint_names():
-            # The automatic-restart entry point must be idempotent: on the
-            # very first launch there is nothing to resume — cold start.
-            self.log("no checkpoint to resume (latest_valid) — starting fresh")
-            snapshot_path = None
+        # Snapshot resume (``:44-45,96-101``). The peek above already
+        # resolved "latest_valid" to the newest checkpoint passing integrity
+        # validation (falling back past a torn last save, emitting
+        # `checkpoint_rejected` for each reject) — or to None on a cold
+        # start — and read its meta.
         if snapshot_path is not None:
             t_restore = time.perf_counter()
-            if snapshot_path == "latest_valid":
-                self.state, self.cur_epoch, snapshot_path = (
-                    self.checkpoints.restore_latest_valid(self.state)
-                )
-            else:
-                self.state, self.cur_epoch = self.checkpoints.restore(
-                    snapshot_path, self.state
-                )
-            meta = self.checkpoints.read_meta(snapshot_path)
+            self.state, self.cur_epoch = self.checkpoints.restore(
+                snapshot_path,
+                self.state,
+                # The peek's latest_valid resolution already hashed every
+                # file; re-validating would double the resume disk reads.
+                validate=not self._resume_prevalidated,
+                # The peek inspected the recorded topology: a mismatch was
+                # either re-planned (mesh=None) or explicitly overridden by
+                # the user's mesh — both restore into a current-backend
+                # layout, so the manager's topology seam may stand down.
+                allow_topology_change=self._topology_changed,
+            )
+            meta = (
+                self._resume_meta
+                if self._resume_meta is not None
+                else self.checkpoints.read_meta(snapshot_path)
+            )
             self._resume_step_in_epoch = int(
                 (meta.get("loop") or {}).get("step_in_epoch", 0)
             )
@@ -521,6 +568,7 @@ class Trainer:
                 epoch=self.cur_epoch,
                 step_in_epoch=self._resume_step_in_epoch,
             )
+            self._emit_elastic_restore(snapshot_path)
             self.log(
                 f"Resumed from {snapshot_path} at epoch {self.cur_epoch}"
                 + (
@@ -750,6 +798,119 @@ class Trainer:
             f"mesh {record['mesh']}: {n_sharded}/{n_leaves} state leaves "
             f"sharded; per-device param bytes {int(per_device)} "
             f"(global {int(global_bytes)})"
+        )
+
+    # ------------------------------------------------------------------
+    # Elastic resume (ISSUE 12; docs/fault_tolerance.md "Elastic training")
+    # ------------------------------------------------------------------
+
+    def _peek_resume_checkpoint(self, snapshot_path, mesh, batch_size):
+        """Resolve the resume checkpoint BEFORE the mesh is chosen.
+
+        Returns the concrete checkpoint name/path to restore (or None for a
+        cold start), maps ``"latest_valid"`` to the newest checkpoint passing
+        integrity validation (the exact choice the restore will make —
+        rejects emit ``checkpoint_rejected``), and reads its meta once (the
+        restore site reuses it). When the recorded sharding topology
+        disagrees with ``jax.device_count()``:
+
+        * ``mesh=None`` — re-plan via :mod:`parallel.elastic`: the solved
+          :class:`MeshConfig` replaces the default mesh and
+          ``self.accum_steps`` is re-solved so the global batch math stays
+          equivalent (``self._elastic_plan`` records the decision);
+        * an explicit ``mesh`` — honored verbatim (the user already chose a
+          current-backend layout); only the topology-change flag is set so
+          the manager's :class:`TopologyMismatchError` seam stands down.
+
+        Same-topology resumes and cold starts set nothing — the historical
+        program is untouched (host-side metadata reads only).
+        """
+        self._elastic_plan = None
+        self._resume_meta = None
+        self._resume_prevalidated = False
+        self._topology_changed = False
+        if snapshot_path is None:
+            return None
+        if snapshot_path == "latest_valid":
+            if not self.checkpoints.checkpoint_names():
+                # The automatic-restart entry point must be idempotent: on
+                # the very first launch there is nothing to resume.
+                self.log("no checkpoint to resume (latest_valid) — starting fresh")
+                return None
+            name = self.checkpoints.latest_valid_name()
+            if name is None:
+                # Same diagnostic the manager's restore_latest_valid raises:
+                # name every checkpoint the walk rejected.
+                raise CheckpointError(
+                    f"no valid checkpoint under {self.checkpoints.directory} "
+                    f"(invalid/corrupt: {self.checkpoints.checkpoint_names() or 'none found'})"
+                )
+            self._resume_prevalidated = True
+            snapshot_path = name
+        try:
+            self._resume_meta = self.checkpoints.read_meta(snapshot_path)
+        except Exception:  # noqa: BLE001 — the restore below raises the
+            return snapshot_path  # canonical corrupt/missing error instead
+        record = self._resume_meta.get("sharding")
+        if not record:
+            return snapshot_path  # pure-DP / pre-sharding: nothing to re-plan
+        saved_axes = elastic_lib.record_axes(record)
+        saved_devices = elastic_lib.axes_device_product(saved_axes)
+        if saved_devices == jax.device_count():
+            return snapshot_path
+        self._topology_changed = True
+        ckpt = os.path.basename(str(snapshot_path))
+        if mesh is not None:
+            self.log(
+                f"resume checkpoint {ckpt!r} was written on {saved_devices} "
+                f"devices (mesh {saved_axes}); this backend has "
+                f"{jax.device_count()} — honoring the explicitly passed mesh "
+                "(no re-plan; accumulation unchanged)."
+            )
+            return snapshot_path
+        self._elastic_plan = elastic_lib.replan(
+            saved_axes,
+            jax.device_count(),
+            batch_size=batch_size,
+            accum_steps=self.accum_steps,
+        )
+        self.accum_steps = self._elastic_plan.accum_steps
+        self.log(
+            f"elastic restore: checkpoint {ckpt!r} was written on "
+            f"{saved_devices} devices (mesh {saved_axes}); re-planned for "
+            f"{jax.device_count()} devices as mesh "
+            f"{self._elastic_plan.new_axes} with accum_steps="
+            f"{self.accum_steps} (was {self._elastic_plan.old_accum_steps}) "
+            "— same effective global batch."
+        )
+        return snapshot_path
+
+    def _emit_elastic_restore(self, snapshot_path) -> None:
+        """One ``elastic_restore`` flight record per topology-changed resume
+        (docs/observability.md): old/new mesh axes and device counts, the
+        old/new accumulation factors, and the re-plan reason."""
+        if not self._topology_changed:
+            return
+        plan = self._elastic_plan
+        if plan is not None:
+            fields = plan.event_fields()
+        else:
+            record = (self._resume_meta or {}).get("sharding") or {}
+            old_axes = elastic_lib.record_axes(record)
+            fields = {
+                "from_mesh": old_axes,
+                "to_mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
+                "from_devices": elastic_lib.axes_device_product(old_axes),
+                "to_devices": jax.device_count(),
+                "old_accum_steps": self.accum_steps,
+                "accum_steps": self.accum_steps,
+                "reason": "explicit mesh (no re-plan)",
+            }
+        self.events.emit(
+            "elastic_restore",
+            name=os.path.basename(str(snapshot_path)),
+            replanned=plan is not None,
+            **fields,
         )
 
     @property
@@ -1570,8 +1731,6 @@ class Trainer:
                 "degrade gracefully)"
             )
         if self.nan_policy == "restore_last_good":
-            from distributed_training_pytorch_tpu.checkpoint import CheckpointError
-
             # Serialize with the background committer: the rollback must see
             # a fully committed newest checkpoint (and the manager is
             # single-threaded by contract — see AsyncCheckpointSaver).

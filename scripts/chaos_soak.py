@@ -27,10 +27,35 @@ Assertions (the acceptance criteria, checked by ``main``):
 4. the async save's hot-loop stall is **< 25 % of the synchronous save wall
    time** (measured on the same digits state by the reference child).
 
+**Elastic mode** (``--elastic``; ISSUE 12) is the elasticity proof on top:
+the child runs on N *forced host devices* (``compat.force_host_devices`` —
+the ``xla_force_host_platform_device_count`` rig) under an fsdp mesh, is
+killed mid-run, and resumes on M ≠ N devices with ``mesh=None`` — the
+Trainer's elastic re-plan must solve the new mesh + grad-accum factor from
+the checkpoint's sharding record without user intervention. Both directions
+run (8→4 shrink and 4→8 grow), asserting:
+
+1. every kill leaves >= 1 valid checkpoint whose meta records the sharded
+   mesh; every elastic resume **succeeds** and reaches completion;
+2. the resumed run's event log carries an ``elastic_restore`` record with
+   the re-planned axes + accumulation factor;
+3. **bit-exact re-plan**: the elastic resume (``mesh=None``, auto accum) is
+   bit-for-bit identical to a *twin* resume of the same post-kill state with
+   the hand-written explicit mesh/accum — pure extent re-grouping by the
+   planner, zero numeric perturbation added (the 4→8 grow leg re-plans with
+   *no* accum change, so the issue's "pure extent re-grouping, no accum
+   change" case is asserted bit-exact);
+4. final params are **equivalent to an uninterrupted same-global-batch run**
+   on the starting topology at documented tolerance (ELASTIC_TOL, see
+   docs/fault_tolerance.md — changing the batch-shard extent legally
+   re-associates float reductions at ~1 ULP/step; measured max|Δ| ≈ 1e-7
+   after 40 steps on this model, asserted at 100x headroom).
+
 Usage::
 
     python scripts/chaos_soak.py --quick      # ~3 kills, CI stage (verify.sh)
     python scripts/chaos_soak.py              # full soak: 5 kills
+    python scripts/chaos_soak.py --elastic --quick  # 8→4 + 4→8 kill/resume
     CHAOS_SEED=7 python scripts/chaos_soak.py # reproduce a failing schedule
 
 ``CHAOS_SEED`` (or ``--seed``) seeds the kill schedule, so a failure
@@ -67,6 +92,13 @@ def child_main(args) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+    if args.devices:
+        # Elastic mode: an N-device virtual CPU platform (must run before
+        # anything initializes the jax backend).
+        from distributed_training_pytorch_tpu import compat
+
+        compat.force_host_devices(args.devices)
+
     import numpy as np
     import optax
     from flax import linen as nn
@@ -75,6 +107,7 @@ def child_main(args) -> int:
 
     from distributed_training_pytorch_tpu.data import ArrayDataSource
     from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+    from distributed_training_pytorch_tpu.parallel import mesh_config_from_spec
     from distributed_training_pytorch_tpu.trainer import Trainer
 
     class DigitsNet(nn.Module):
@@ -115,6 +148,11 @@ def child_main(args) -> int:
         def build_scheduler(self):
             return 0.1
 
+    # Elastic mode: --mesh SPEC pins an explicit sharded mesh (the killed
+    # run, and the "twin" resume that hand-writes what the re-plan should
+    # solve); an empty spec is mesh=None — the elastic-restore path, which
+    # must re-plan the recorded mesh for THIS process's device count.
+    mesh = mesh_config_from_spec(args.mesh).build() if args.mesh else None
     trainer = SoakTrainer(
         max_epoch=args.max_epoch,
         batch_size=128,
@@ -130,6 +168,11 @@ def child_main(args) -> int:
         num_workers=0,
         progress=False,
         seed=0,
+        mesh=mesh,
+        accum_steps=args.accum,
+        # DigitsNet's kernels are tiny; a small cutoff makes the fsdp mesh
+        # genuinely shard them so checkpoints carry a sharding record.
+        fsdp_min_size=256,
     )
     if args.commit_delay > 0:
         # Chaos seam: hold each background commit in the `committing` state
@@ -243,7 +286,8 @@ class EventTail:
         return records
 
 
-def spawn_child(script, run_dir, final, max_epoch, commit_delay, measure_stall, log):
+def spawn_child(script, run_dir, final, max_epoch, commit_delay, measure_stall, log,
+                *, devices=0, mesh="", accum=1):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     # NO persistent XLA compilation cache here, deliberately: a SIGKILL'd
@@ -257,6 +301,9 @@ def spawn_child(script, run_dir, final, max_epoch, commit_delay, measure_stall, 
         "--final", final,
         "--max-epoch", str(max_epoch),
         "--commit-delay", str(commit_delay),
+        "--devices", str(devices),
+        "--mesh", mesh,
+        "--accum", str(accum),
     ]
     if measure_stall:
         cmd.append("--measure-stall")
@@ -417,6 +464,191 @@ def run_soak(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# Elastic mode (ISSUE 12): kill on N forced-host devices, resume on M.
+
+# Final-params equivalence tolerance vs the uninterrupted reference run.
+# Rationale (docs/fault_tolerance.md): changing the batch-shard extent
+# re-groups the gradient reductions' participant sets, which legally
+# re-associates float32 sums at ~1 ULP per step — measured max|Δ| ≈ 1e-7
+# after 40 steps on this DigitsNet (8-dev fsdp8 vs 4-dev fsdp4, identical
+# global batches); asserted with ~100x headroom. BIT-exactness is asserted
+# where it is the truth: the elastic resume vs the hand-configured twin on
+# the same topology.
+ELASTIC_TOL = 1e-4
+
+
+def run_elastic_soak(args) -> int:
+    script = os.path.abspath(__file__)
+    seed = int(os.environ.get("CHAOS_SEED", args.seed))
+    import random
+
+    rng = random.Random(seed)
+    workdir = tempfile.mkdtemp(prefix="chaos_elastic_")
+    max_epoch = 2 if args.quick else 3
+    # (tag, N, start mesh spec, kill, M, expected re-planned axes,
+    #  expected re-planned accum, the explicit twin's spec)
+    phases = [
+        ("8to4", 8, "fsdp8", "SIGTERM", 4, {"data": 1, "fsdp": 4}, 2, "fsdp4x1"),
+        ("4to8", 4, "fsdp4x1", "SIGKILL", 8, {"data": 2, "fsdp": 4}, 1, "fsdp4x2"),
+    ]
+    print(
+        f"chaos_soak --elastic: seed={seed} max_epoch={max_epoch} "
+        f"workdir={workdir}\n  phases: "
+        + ", ".join(f"{t} ({s} {sig})" for t, _, s, sig, *_ in phases)
+    )
+    failures: list[str] = []
+    try:
+        for phase in phases:
+            _elastic_phase(script, workdir, max_epoch, rng, failures, *phase)
+    finally:
+        if args.keep:
+            print(f"chaos_soak: artifacts kept at {workdir}")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print("ELASTIC CHAOS SOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        print(f"  reproduce with CHAOS_SEED={seed}", file=sys.stderr)
+        return 1
+    print(
+        "elastic chaos soak OK: 8->4 and 4->8 kill/resume both re-planned, "
+        "bit-exact with their explicit twins, and equivalent to the "
+        f"uninterrupted runs within {ELASTIC_TOL}"
+    )
+    return 0
+
+
+def _elastic_phase(script, workdir, max_epoch, rng, failures,
+                   tag, n, spec, sig_name, m, want_axes, want_accum, twin_spec):
+    import numpy as np
+
+    base = os.path.join(workdir, tag)
+    os.makedirs(base, exist_ok=True)
+
+    # 1. Uninterrupted reference on the START topology (the "same global
+    # batch" comparison run of the acceptance criteria).
+    ref_final = os.path.join(base, "ref_final.npz")
+    ref_log = os.path.join(base, "ref.log")
+    with open(ref_log, "w") as log:
+        rc = wait_child(spawn_child(
+            script, os.path.join(base, "ref"), ref_final, max_epoch, 0.0,
+            False, log, devices=n, mesh=spec,
+        ))
+    if rc != EXIT_OK or not os.path.isfile(ref_final):
+        print(open(ref_log).read()[-3000:], file=sys.stderr)
+        failures.append(f"{tag}: reference run on {n} devices failed (exit {rc})")
+        return
+
+    # 2. The killed run: N devices, sharded mesh, seeded kill point.
+    soak_dir = os.path.join(base, "soak")
+    weights = os.path.join(soak_dir, "weights")
+    events = EventTail(os.path.join(soak_dir, "telemetry", "events.jsonl"))
+    soak_final = os.path.join(base, "soak_final.npz")
+    soak_log = os.path.join(base, "soak.log")
+    log = open(soak_log, "w")
+    try:
+        proc = spawn_child(
+            script, soak_dir, soak_final, max_epoch, 0.0, False, log,
+            devices=n, mesh=spec,
+        )
+        died = _wait_and_kill(proc, events, weights, sig_name, "step", rng)
+        rc = wait_child(proc, timeout=60.0)
+        survivors = valid_checkpoints(weights)
+        print(
+            f"  {tag}: {sig_name} on {n} devices ({died}) -> exit {rc}, "
+            f"{len(survivors)} valid checkpoint(s): {survivors}"
+        )
+        if died == "child exited before kill":
+            failures.append(f"{tag}: kill never landed — child completed first")
+            return
+        if not survivors:
+            failures.append(f"{tag}: {sig_name} kill left ZERO valid checkpoints")
+            return
+        if sig_name == "SIGTERM" and rc != EXIT_PREEMPTED:
+            failures.append(
+                f"{tag}: SIGTERM child exited {rc}, expected clean preemption "
+                f"exit {EXIT_PREEMPTED}"
+            )
+
+        # 3. Twin copy of the post-kill state BEFORE the resume mutates it.
+        twin_dir = os.path.join(base, "twin")
+        shutil.copytree(soak_dir, twin_dir)
+
+        # 4. Elastic resume: M devices, mesh=None — the Trainer must re-plan
+        # from the checkpoint's sharding record without user intervention.
+        rc = wait_child(spawn_child(
+            script, soak_dir, soak_final, max_epoch, 0.0, False, log,
+            devices=m, mesh="", accum=1,
+        ))
+        if rc != EXIT_OK or not os.path.isfile(soak_final):
+            print(open(soak_log).read()[-3000:], file=sys.stderr)
+            failures.append(
+                f"{tag}: elastic resume on {m} devices did not complete (exit {rc})"
+            )
+            return
+
+        # 5. The resume's flight record must carry the re-plan.
+        recs = [r for r in events.poll() if r.get("event") == "elastic_restore"]
+        if not recs:
+            failures.append(f"{tag}: no elastic_restore event in the resumed run's log")
+        else:
+            rec = recs[-1]
+            if rec.get("to_mesh") != want_axes or not rec.get("replanned"):
+                failures.append(
+                    f"{tag}: elastic_restore re-planned {rec.get('from_mesh')} -> "
+                    f"{rec.get('to_mesh')} (replanned={rec.get('replanned')}); "
+                    f"expected {want_axes}"
+                )
+            if rec.get("accum_steps") != want_accum:
+                failures.append(
+                    f"{tag}: elastic_restore accum_steps={rec.get('accum_steps')}, "
+                    f"expected {want_accum}"
+                )
+
+        # 6. Explicit twin: the same post-kill state resumed with the
+        # hand-written mesh/accum the re-plan should have solved.
+        twin_final = os.path.join(base, "twin_final.npz")
+        rc = wait_child(spawn_child(
+            script, twin_dir, twin_final, max_epoch, 0.0, False, log,
+            devices=m, mesh=twin_spec, accum=want_accum,
+        ))
+        if rc != EXIT_OK or not os.path.isfile(twin_final):
+            failures.append(f"{tag}: explicit twin resume did not complete (exit {rc})")
+            return
+    finally:
+        log.close()
+
+    # 7. Bit-exactness: the elastic re-plan adds zero numeric perturbation
+    # over the hand-configured program (the 4->8 leg re-plans with NO accum
+    # change — the pure-extent-re-grouping case, asserted bit-exact).
+    elastic, twin = np.load(soak_final), np.load(twin_final)
+    if sorted(elastic.files) != sorted(twin.files) or not all(
+        np.array_equal(elastic[k], twin[k]) for k in elastic.files
+    ):
+        failures.append(
+            f"{tag}: elastic resume NOT bit-exact with the explicit "
+            f"{twin_spec}/accum={want_accum} twin"
+        )
+    else:
+        change = "no accum change" if want_accum == 1 else f"accum -> {want_accum}"
+        print(f"  {tag}: elastic resume bit-exact with the explicit twin ({change})")
+
+    # 8. Equivalence with the uninterrupted reference at documented tolerance.
+    ref = np.load(ref_final)
+    worst = max(float(np.max(np.abs(ref[k] - elastic[k]))) for k in ref.files)
+    print(
+        f"  {tag}: final params vs uninterrupted {n}-device run: "
+        f"max|d| = {worst:.2e} (tolerance {ELASTIC_TOL})"
+    )
+    if not (worst <= ELASTIC_TOL):
+        failures.append(
+            f"{tag}: final params diverged from the uninterrupted run "
+            f"(max|d| {worst:.2e} > {ELASTIC_TOL})"
+        )
+
+
 def _wait_and_kill(proc, events, weights_dir, sig_name, trigger, rng) -> str:
     """Block until the seeded trigger condition holds, then deliver the
     signal. Returns a short description of the actual kill point."""
@@ -469,6 +701,11 @@ def main() -> int:
     parser.add_argument("--kills", type=int, default=5, help="kill count (full mode)")
     parser.add_argument("--seed", type=int, default=0, help="kill-schedule seed (CHAOS_SEED wins)")
     parser.add_argument("--keep", action="store_true", help="keep the work dir")
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="elastic mode: kill on N forced-host devices, resume on M "
+        "(8->4 and 4->8; ISSUE 12)",
+    )
     # child-mode flags
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--run-dir", dest="run_dir", help=argparse.SUPPRESS)
@@ -476,9 +713,14 @@ def main() -> int:
     parser.add_argument("--max-epoch", dest="max_epoch", type=int, default=3, help=argparse.SUPPRESS)
     parser.add_argument("--commit-delay", dest="commit_delay", type=float, default=0.0, help=argparse.SUPPRESS)
     parser.add_argument("--measure-stall", dest="measure_stall", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--devices", type=int, default=0, help=argparse.SUPPRESS)
+    parser.add_argument("--mesh", default="", help=argparse.SUPPRESS)
+    parser.add_argument("--accum", type=int, default=1, help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.child:
         return child_main(args)
+    if args.elastic:
+        return run_elastic_soak(args)
     return run_soak(args)
 
 

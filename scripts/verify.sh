@@ -66,19 +66,30 @@
 # uninterrupted run, and the async save's hot-loop stall is < 25% of the sync
 # save wall time. CHAOS_SEED reproduces a failing schedule deterministically.
 #
-# Stage 9 is the perf-regression gate (docs/profiling.md): a ~10s CPU
+# Stage 9 is the elastic chaos soak (ISSUE 12): the same digits job run on
+# 8 forced-host devices under an fsdp=8 mesh, killed (SIGTERM / SIGKILL) and
+# resumed on 4 devices with mesh=None — the Trainer must re-plan the mesh +
+# grad-accum factor from the checkpoint's sharding record — plus the mirror
+# 4->8 grow leg. Asserts every kill leaves a valid sharded checkpoint, every
+# elastic resume completes and logs an elastic_restore event with the
+# expected axes/accum, the elastic resume is BIT-EXACT with an explicitly
+# hand-configured twin resume (the 4->8 leg with no accum change), and final
+# params match an uninterrupted same-global-batch run within the documented
+# tolerance (docs/fault_tolerance.md).
+#
+# Stage 10 is the perf-regression gate (docs/profiling.md): a ~10s CPU
 # measurement of the real chained-engine path, gated as a machine-portable
 # calibrated ratio against the committed PERF_BASELINE.json — a step-time
 # regression past tolerance (an accidental retrace, a lost chained dispatch
 # path) fails here. The gate's own teeth are tested on every run: a
 # deliberate 3x injected slowdown must make it FAIL.
 #
-# Stage 10 is the ROADMAP.md tier-1 command verbatim.
+# Stage 11 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/10: import health (pytest --collect-only) =="
+echo "== stage 1/11: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -87,7 +98,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/10: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/11: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -113,25 +124,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/10: chained-dispatch retrace guard =="
+echo "== stage 3/11: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/10: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/11: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/10: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/11: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/10: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/11: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -141,19 +152,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/10: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/11: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/10: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/11: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/10: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 9/11: elastic chaos soak (kill on N devices, resume on M) =="
+if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
+  echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
+  echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
+  exit 11
+fi
+
+echo "== stage 10/11: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -165,7 +183,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 10/10: tier-1 test suite =="
+echo "== stage 11/11: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

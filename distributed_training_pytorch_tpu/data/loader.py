@@ -99,6 +99,15 @@ class ShardedLoader:
         # shards instead; the footer-index read is cheap).
         self.skip_corrupt = bool(skip_corrupt)
         self._corrupt_skipped = 0
+        # Injection seam (ISSUE 13; the FaultPlan/commit_delay_s pattern for
+        # the input pipeline): sleep this long in every batch's production
+        # path, on the producing thread — a deterministic way to make the
+        # loader the bottleneck so the telemetry `data_wait` bucket, the
+        # perf gate's data_wait ceiling (scripts/perf_gate.py --data-wait
+        # --inject-data-wait), and the run doctor's data_bound verdict can
+        # be self-tested against a KNOWN starved pipeline. Production
+        # leaves it 0; settable post-construction (loader.load_delay_s=...).
+        self.load_delay_s = 0.0
         if skip_corrupt and hasattr(source, "skip_corrupt"):
             source.skip_corrupt = True
         self._epoch = 0
@@ -187,7 +196,14 @@ class ShardedLoader:
             return "arrays"
         return None
 
+    def _maybe_delay(self) -> None:
+        if self.load_delay_s:
+            import time
+
+            time.sleep(float(self.load_delay_s))  # injection seam (see ctor)
+
     def _produce_batch(self, rows: np.ndarray, mask, epoch: int, fast: str | None) -> dict:
+        self._maybe_delay()
         if fast == "source":
             batch = dict(self.source.load_batch(rows, epoch))
         elif fast == "arrays":
@@ -292,4 +308,5 @@ class ShardedLoader:
                 if fast is not None:
                     yield item.result()
                 else:
+                    self._maybe_delay()  # per-record path: delay at collate
                     yield self._collate([f.result() for f in item], mask)

@@ -16,7 +16,13 @@ Two comparison modes, one rule (``measured <= baseline * (1 + tolerance)``):
   calibration kernel's time on the same machine) — for the CPU verify gate,
   where absolute milliseconds vary across dev machines but the *ratio* of
   two programs on the same machine is stable. Machine speed cancels to first
-  order, so one committed baseline serves every contributor.
+  order, so one committed baseline serves every contributor;
+* **goodput-fraction ceiling** (``data_wait_frac`` — ISSUE 13 /
+  ROADMAP item 5): the committed entry is a ceiling on the steady-state
+  ``data_wait`` goodput fraction of a small real-Trainer run
+  (``scripts/perf_gate.py --data-wait``), so the input pipeline cannot
+  quietly become the bottleneck. Same rule — a fraction is already
+  machine-portable.
 
 The module is pure logic (no timing, no I/O beyond the baseline file) so the
 pass/fail semantics are unit-testable on synthetic baselines — including the
@@ -69,7 +75,7 @@ class GateResult:
             f"tolerance +{100 * self.tolerance:.0f}%)"
         )
         if not self.passed:
-            line += " — step-time REGRESSION past tolerance"
+            line += f" — {self.metric} REGRESSION past tolerance"
         elif self.stale:
             line += (
                 " — faster than baseline beyond tolerance; re-record it "
@@ -141,8 +147,14 @@ def evaluate(
             f"tolerance[{key!r}] record in the file, no caller default); "
             "re-record with scripts/perf_gate.py --update"
         )
-    if "step_per_calib" in entry and "step_per_calib" in measurement:
-        metric = "step_per_calib"
+    # Metric preference: the machine-portable calibrated ratio, then the
+    # goodput-fraction ceiling (the --data-wait mode, ISSUE 13 — the entry
+    # records a CEILING, same fail-iff-measured-exceeds rule), then
+    # absolute milliseconds.
+    for candidate in ("step_per_calib", "data_wait_frac"):
+        if candidate in entry and candidate in measurement:
+            metric = candidate
+            break
     else:
         metric = "step_ms"
     if metric not in entry:
@@ -154,10 +166,16 @@ def evaluate(
             f"— it cannot gate this measurement; re-record it with "
             "scripts/perf_gate.py --update"
         )
-    return check(
+    result = check(
         float(measurement[metric]), float(entry[metric]), float(tolerance),
         key=key, metric=metric,
     )
+    if metric == "data_wait_frac":
+        # The entry is a CEILING recorded with deliberate headroom
+        # (perf_gate --data-wait --update): sitting well under it is the
+        # healthy state, not a stale baseline to re-record.
+        result.stale = False
+    return result
 
 
 def update_baseline(

@@ -17,7 +17,18 @@ preemption events, loss-scale state) into one surface:
 * :mod:`~.mfu`     — MFU + roofline fields from cost analysis and measured
   step time, shared by ``bench.py`` and the trainer's per-window reports;
 * :mod:`~.anomaly` — host-side detectors (loss spike / grad explosion /
-  step-time regression) that run only at existing sync points.
+  step-time regression / memory growth / straggler) that run only at
+  existing sync points;
+* :mod:`~.straggler` — per-chip arrival-skew sampling at the ``log_every``
+  syncs (the PR 8 live-memory-skew pattern applied to time), feeding the
+  ``straggler`` anomaly kind and the doctor's attribution (ISSUE 13);
+* :mod:`~.timeline` — merges a run directory's event log into one
+  Chrome/Perfetto trace (windows, epochs, the goodput partition as spans,
+  checkpoint snapshot/commit lifecycles with the async committer as its
+  own track, profile captures, narrative markers);
+* :mod:`~.doctor`   — the ranked bottleneck diagnosis (compile-bound /
+  data-bound / checkpoint-stall / straggler / comm-heavy / healthy) shared
+  by ``scripts/run_doctor.py`` and the epoch-end ``doctor/*`` scalars.
 
 Wire-up: ``Trainer(telemetry="on")`` (or a :class:`Telemetry` instance for
 knobs); entries honor ``TELEMETRY=1``; see ``docs/observability.md``.
@@ -33,6 +44,7 @@ from distributed_training_pytorch_tpu.telemetry.anomaly import (  # noqa: F401
     AnomalyError,
 )
 from distributed_training_pytorch_tpu.telemetry.events import (  # noqa: F401
+    SCHEMA_VERSION,
     EventLog,
     read_events,
 )
@@ -59,6 +71,7 @@ __all__ = [
     "EventLog",
     "GoodputMeter",
     "PEAK_FLOPS",
+    "SCHEMA_VERSION",
     "STAT_KEYS",
     "Telemetry",
     "device_peak_flops",
@@ -68,6 +81,11 @@ __all__ = [
     "train_health_stats",
     "window_report",
 ]
+
+# timeline/doctor/straggler are imported as submodules on demand
+# (``from distributed_training_pytorch_tpu.telemetry import timeline``) —
+# the trainer hot path must not pay their import, and the package root
+# stays import-light for the historical program.
 
 
 @dataclasses.dataclass
@@ -93,7 +111,14 @@ class Telemetry:
       hosts) on the per-window records, read at the existing ``log_every``
       host syncs (a PJRT allocator query — zero extra device syncs), and
       fed to the anomaly detector's ``memory_growth`` leak check. Degrades
-      to absent fields on backends without ``memory_stats`` (CPU).
+      to absent fields on backends without ``memory_stats`` (CPU);
+    * ``straggler``      — per-chip arrival-skew fields
+      (``chip_wall_ms_min/max``, ``chip_skew_ms``, ``slowest_chip``,
+      ``straggler_ratio`` from ``telemetry.straggler``) on the per-window
+      records, sampled at the same ``log_every`` host syncs (the sync was
+      about to block on every chip anyway — zero extra device syncs), and
+      fed to the anomaly detector's floor-baselined ``straggler`` check.
+      Degrades to absent fields on single-chip hosts.
     """
 
     events_path: str | None = None
@@ -103,6 +128,7 @@ class Telemetry:
     flops_per_step: float | None = None
     anomaly: AnomalyDetector | str | None = "warn"
     memory: bool = True
+    straggler: bool = True
 
     def resolve_anomaly(self) -> AnomalyDetector | None:
         if self.anomaly is None:

@@ -31,10 +31,10 @@ class AnomalyError(RuntimeError):
 @dataclasses.dataclass
 class Anomaly:
     """One finding: ``kind`` is ``loss_spike`` | ``grad_explosion`` |
-    ``step_time_regression`` | ``memory_growth``; ``value`` tripped at
-    ``factor`` x ``baseline`` (the EWMA at detection time — or, for
-    ``memory_growth``, the steady-state live-byte floor) at global step
-    ``step``."""
+    ``step_time_regression`` | ``memory_growth`` | ``straggler``; ``value``
+    tripped at ``factor`` x ``baseline`` (the EWMA at detection time — or,
+    for the floor-baselined kinds ``memory_growth``/``straggler``, the
+    post-warmup steady-state floor) at global step ``step``."""
 
     kind: str
     step: int
@@ -68,6 +68,14 @@ class AnomalyDetector:
     metrics list pinning device arrays) eventually crosses
     ``factor x floor`` no matter how gradual the slope. Signals whose value
     is absent (statless backends pass ``live_bytes=None``) never fire.
+
+    ``straggler`` (ISSUE 13) uses the same floor rule on the per-window
+    slowest-chip ratio (``telemetry.straggler.ratio`` — 1.0 = chips in
+    lockstep): a healthy mesh's ratio floor sits near 1, and a chip that
+    degrades *gradually* (thermals, a failing link) would drag an EWMA
+    with it exactly like a slow leak — the post-warmup floor cannot be
+    dragged, so the ratio eventually crosses ``factor x floor``. Absent on
+    single-chip hosts (the sampler returns no ratio): never fires.
     """
 
     def __init__(
@@ -78,6 +86,7 @@ class AnomalyDetector:
         grad_explosion: float | None = 10.0,
         step_time_regression: float | None = 2.5,
         memory_growth: float | None = 1.5,
+        straggler: float | None = 1.5,
         ewma_alpha: float = 0.1,
         warmup: int = 5,
     ):
@@ -90,11 +99,12 @@ class AnomalyDetector:
             "step_time_regression": step_time_regression,
         }
         self.memory_growth = memory_growth
+        self.straggler = straggler
         self.ewma_alpha = float(ewma_alpha)
         self.warmup = int(warmup)
         self._ewma: dict[str, float] = {}
         self._seen: dict[str, int] = {}
-        self._mem_floor: float | None = None
+        self._floors: dict[str, float] = {}
         self.total_fired = 0
 
     def _check(self, kind: str, value: float | None, step: int) -> Anomaly | None:
@@ -124,27 +134,29 @@ class AnomalyDetector:
         self._seen[kind] = seen + 1
         return anomaly
 
-    def _check_memory(self, value: float | None, step: int) -> Anomaly | None:
-        """Floor-baselined leak detection (see class docstring): warmup
-        observations pass untracked (allocator ramp — caches, prefetch
-        staging — is normal), then the running minimum is the steady-state
-        floor and a value above ``memory_growth x floor`` is a leak. The
-        floor only ever moves DOWN, so it never absorbs the leak it is
-        there to catch."""
-        if value is None or self.memory_growth is None:
+    def _check_floor(
+        self, kind: str, factor: float | None, value: float | None, step: int
+    ) -> Anomaly | None:
+        """Floor-baselined detection (see class docstring; shared by
+        ``memory_growth`` and ``straggler``): warmup observations pass
+        untracked (allocator ramp / compile-skewed first windows are
+        normal), then the running minimum is the steady-state floor and a
+        value above ``factor x floor`` fires. The floor only ever moves
+        DOWN, so it never absorbs the drift it is there to catch."""
+        if value is None or factor is None:
             return None
         value = float(value)
-        seen = self._seen.get("memory_growth", 0)
-        self._seen["memory_growth"] = seen + 1
+        seen = self._seen.get(kind, 0)
+        self._seen[kind] = seen + 1
         if seen < self.warmup or not math.isfinite(value):
             return None
-        floor = self._mem_floor
+        floor = self._floors.get(kind)
         if floor is None:
-            self._mem_floor = value
+            self._floors[kind] = value
             return None
-        self._mem_floor = min(floor, value)
-        if value > self.memory_growth * floor:
-            return Anomaly("memory_growth", step, value, floor, self.memory_growth)
+        self._floors[kind] = min(floor, value)
+        if value > factor * floor:
+            return Anomaly(kind, step, value, floor, factor)
         return None
 
     def observe(
@@ -155,6 +167,7 @@ class AnomalyDetector:
         grad_norm: float | None = None,
         step_time: float | None = None,
         live_bytes: float | None = None,
+        straggler_ratio: float | None = None,
     ) -> list[Anomaly]:
         """Feed one sync point's values; returns the anomalies fired (empty
         list almost always). ``step`` labels findings only."""
@@ -167,8 +180,12 @@ class AnomalyDetector:
             a = self._check(kind, value, int(step))
             if a is not None:
                 found.append(a)
-        a = self._check_memory(live_bytes, int(step))
-        if a is not None:
-            found.append(a)
+        for kind, factor, value in (
+            ("memory_growth", self.memory_growth, live_bytes),
+            ("straggler", self.straggler, straggler_ratio),
+        ):
+            a = self._check_floor(kind, factor, value, int(step))
+            if a is not None:
+                found.append(a)
         self.total_fired += len(found)
         return found

@@ -1,0 +1,306 @@
+"""The run doctor: a ranked, machine-checkable diagnosis of a training run.
+
+The instruments are installed — goodput buckets (PR 4), profile captures
+(PR 6), live memory (PR 8), straggler skew (this PR) — but reading them
+still took a human. This module turns the signals into one of six
+verdicts, each carrying the evidence rows (goodput fractions, event-log
+line numbers, timeline track refs) that justify it:
+
+=====================  ====================================================
+verdict                signature
+=====================  ====================================================
+``compile_bound``      non-probe ``compile`` events in epoch >= 1 — the
+                       steady state is retracing (warmup compiles in epoch
+                       0 are normal and never fire this)
+``data_bound``         steady-state ``data_wait`` fraction over the ceiling
+                       (default 20%) — the input pipeline starves the chips
+``checkpoint_stall``   steady-state ``checkpoint`` fraction over the
+                       ceiling (default 20%) — hot-loop save stalls /
+                       commit backpressure dominate
+``straggler``          a ``straggler``/``step_time_regression`` anomaly or
+                       ``hung_step`` fired, or the worst window's
+                       slowest-chip ratio exceeds the threshold — one chip
+                       (or a host hang) is pacing the job
+``comm_heavy``         the profile capture attributes more than the
+                       threshold of device wall to ``collective`` ops —
+                       the sharding plan spends the chips on the wire
+``healthy``            none of the above
+=====================  ====================================================
+
+**Steady-state fractions.** Verdicts divide by the wall the run could
+actually control: ``total - compile - restart_rollback -
+checkpoint_async`` (one-time warmup, resume overhead, and overlapped
+background commits excluded). A two-epoch CPU smoke run spends half its
+wall in XLA compile; dividing data_wait by *total* would let a genuinely
+data-bound run hide behind warmup, and a clean short run misread as
+healthy-by-dilution. The perf gate's ``data_wait`` ceiling
+(``scripts/perf_gate.py --data-wait``) gates the SAME
+:func:`steady_fractions` figure, so the gate and the doctor cannot
+disagree about what "data-bound" means.
+
+Scores are severities normalized to the threshold: ``score >= 1.0`` means
+"over the line", and verdicts rank by score. The same rules run in two
+places: offline over a run directory's event log
+(:func:`extract_signals` + :func:`diagnose` — ``scripts/run_doctor.py``),
+and live at epoch end from the trainer's in-memory counters
+(:func:`scalar_fields` — the ``doctor/*`` TensorBoard scalars), so the
+dashboard sees what the offline doctor would say.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distributed_training_pytorch_tpu.telemetry.goodput import BUCKETS
+
+__all__ = [
+    "Diagnosis",
+    "Signals",
+    "THRESHOLDS",
+    "VERDICTS",
+    "Verdict",
+    "diagnose",
+    "extract_signals",
+    "scalar_fields",
+    "steady_fractions",
+]
+
+VERDICTS = (
+    "compile_bound",
+    "data_bound",
+    "checkpoint_stall",
+    "straggler",
+    "comm_heavy",
+    "healthy",
+)
+
+# Firing ceilings. A verdict's score is measured/threshold (>= 1.0 fires);
+# the thresholds are deliberately generous — the doctor names what
+# DOMINATES a run, not every inefficiency.
+THRESHOLDS = {
+    "data_wait_frac": 0.20,
+    "checkpoint_frac": 0.20,
+    "straggler_ratio": 1.5,
+    "comm_frac": 0.25,
+}
+
+# Buckets excluded from the steady-state denominator (see module doc).
+_EXCLUDED = ("compile", "restart_rollback", "checkpoint_async")
+
+
+def steady_fractions(seconds: dict) -> dict:
+    """Bucket fractions of the steady-state wall (warmup/resume/overlapped
+    buckets excluded from the denominator; their own fractions report 0).
+    All zeros when nothing steady-state was accounted."""
+    steady = {b: float(seconds.get(b, 0.0)) for b in BUCKETS}
+    denom = sum(v for b, v in steady.items() if b not in _EXCLUDED)
+    if denom <= 0.0:
+        return {b: 0.0 for b in BUCKETS}
+    return {b: (0.0 if b in _EXCLUDED else v / denom) for b, v in steady.items()}
+
+
+@dataclasses.dataclass
+class Signals:
+    """The doctor's inputs, source-agnostic: :func:`extract_signals` fills
+    them from an event log; the trainer fills them from live counters."""
+
+    goodput_seconds: dict | None = None
+    anomaly_counts: dict = dataclasses.field(default_factory=dict)
+    hung_steps: int = 0
+    max_straggler_ratio: float | None = None
+    late_compiles: int = 0
+    comm_frac: float | None = None
+    # Evidence rows keyed by verdict kind: lists of {"metric"/"value"/
+    # "line"/"timeline"} dicts accumulated during extraction.
+    evidence: dict = dataclasses.field(default_factory=dict)
+
+    def note(self, kind: str, **row) -> None:
+        self.evidence.setdefault(kind, []).append(row)
+
+
+@dataclasses.dataclass
+class Verdict:
+    kind: str
+    score: float
+    summary: str
+    evidence: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Diagnosis:
+    verdicts: list  # ranked, most severe first; never empty
+    signals: Signals
+
+    @property
+    def verdict(self) -> str:
+        return self.verdicts[0].kind
+
+    @property
+    def healthy(self) -> bool:
+        return self.verdict == "healthy"
+
+    def to_dict(self) -> dict:
+        return {
+            "verdict": self.verdict,
+            "healthy": self.healthy,
+            "verdicts": [v.to_dict() for v in self.verdicts],
+            "steady_fractions": steady_fractions(self.signals.goodput_seconds or {}),
+        }
+
+    def describe(self) -> str:
+        lines = []
+        for i, v in enumerate(self.verdicts, 1):
+            lines.append(f"  {i}. [{v.kind}] score {v.score:.2f} — {v.summary}")
+            for row in v.evidence:
+                cite = ", ".join(
+                    f"{k}={row[k]}" for k in ("metric", "value", "threshold", "line", "timeline")
+                    if row.get(k) is not None
+                )
+                lines.append(f"       evidence: {cite}")
+        return "\n".join(lines)
+
+
+def extract_signals(events: list[dict]) -> Signals:
+    """Distill an event log (``timeline.load_run_events`` output — records
+    carry ``_line``) into :class:`Signals`, citing line numbers and the
+    timeline track each piece of evidence lands on."""
+    sig = Signals()
+    for rec in events:
+        kind = rec.get("event")
+        line = rec.get("_line")
+        if isinstance(rec.get("goodput_seconds"), dict):
+            # Cumulative counters: the LAST snapshot wins (append-across-
+            # restarts keeps them cumulative over the whole job).
+            sig.goodput_seconds = dict(rec["goodput_seconds"])
+            sig.note("goodput", metric="goodput_seconds", line=line, timeline="goodput")
+        if kind == "anomaly":
+            akind = str(rec.get("kind"))
+            sig.anomaly_counts[akind] = sig.anomaly_counts.get(akind, 0) + 1
+            if akind in ("straggler", "step_time_regression"):
+                sig.note("straggler", metric=f"anomaly:{akind}",
+                         value=rec.get("value"), line=line, timeline="markers")
+        elif kind == "hung_step":
+            sig.hung_steps += 1
+            sig.note("straggler", metric="hung_step",
+                     value=rec.get("timeout_s"), line=line, timeline="markers")
+        elif kind == "window" and rec.get("straggler_ratio") is not None:
+            r = float(rec["straggler_ratio"])
+            if sig.max_straggler_ratio is None or r > sig.max_straggler_ratio:
+                sig.max_straggler_ratio = r
+                sig.note("straggler_ratio", metric="straggler_ratio", value=round(r, 4),
+                         line=line, timeline="steps")
+        elif kind == "compile" and rec.get("kind") != "mfu_probe":
+            if int(rec.get("epoch", 0) or 0) >= 1:
+                sig.late_compiles += 1
+                sig.note("compile_bound", metric="late_compile",
+                         value=rec.get("executables"), line=line, timeline="markers")
+        elif kind == "profile_capture" and isinstance(rec.get("categories"), dict):
+            sig.comm_frac = float(rec["categories"].get("collective", 0.0))
+            sig.note("comm_heavy", metric="collective_frac",
+                     value=round(sig.comm_frac, 4), line=line, timeline="profile")
+    return sig
+
+
+def _verdicts(sig: Signals) -> list[Verdict]:
+    found = []
+    fr = steady_fractions(sig.goodput_seconds or {})
+
+    def frac_verdict(kind, bucket, threshold_key, what):
+        f = fr.get(bucket, 0.0)
+        threshold = THRESHOLDS[threshold_key]
+        score = f / threshold
+        if score >= 1.0:
+            ev = [dict(metric=f"{bucket}_frac_steady", value=round(f, 4),
+                       threshold=threshold, timeline="goodput")]
+            ev += sig.evidence.get("goodput", [])
+            found.append(Verdict(
+                kind, score,
+                f"{what}: {bucket} is {100 * f:.0f}% of steady-state wall "
+                f"(ceiling {100 * threshold:.0f}%)", ev))
+        return score
+
+    frac_verdict("data_bound", "data_wait", "data_wait_frac",
+                 "the input pipeline starves the chips")
+    frac_verdict("checkpoint_stall", "checkpoint", "checkpoint_frac",
+                 "checkpoint saves stall the hot loop")
+
+    if sig.late_compiles > 0:
+        found.append(Verdict(
+            "compile_bound", 1.0 + float(sig.late_compiles),
+            f"{sig.late_compiles} executable(s) compiled past epoch 0 — the "
+            "steady state is retracing (a shape leak or a lost executable "
+            "cache), not warmup",
+            sig.evidence.get("compile_bound", [])))
+
+    strag_score = 0.0
+    if sig.max_straggler_ratio is not None:
+        strag_score = sig.max_straggler_ratio / THRESHOLDS["straggler_ratio"]
+    n_anom = sig.anomaly_counts.get("straggler", 0)
+    n_regress = sig.anomaly_counts.get("step_time_regression", 0)
+    if n_anom:
+        strag_score = max(strag_score, 1.0 + float(n_anom))
+    if n_regress:
+        strag_score = max(strag_score, 1.0 + 0.5 * n_regress)
+    if sig.hung_steps:
+        strag_score = max(strag_score, 2.0 + float(sig.hung_steps))
+    if strag_score >= 1.0:
+        parts = []
+        if n_anom:
+            parts.append(f"{n_anom} straggler anomaly(ies)")
+        if n_regress:
+            parts.append(f"{n_regress} step-time regression(s)")
+        if sig.hung_steps:
+            parts.append(f"{sig.hung_steps} hung step(s)")
+        if sig.max_straggler_ratio is not None and (
+            sig.max_straggler_ratio >= THRESHOLDS["straggler_ratio"]
+        ):
+            parts.append(f"worst slowest-chip ratio {sig.max_straggler_ratio:.2f}")
+        found.append(Verdict(
+            "straggler", strag_score,
+            "one chip (or a host-side hang) is pacing the job: " + ", ".join(parts),
+            sig.evidence.get("straggler", []) + sig.evidence.get("straggler_ratio", [])))
+
+    if sig.comm_frac is not None:
+        score = sig.comm_frac / THRESHOLDS["comm_frac"]
+        if score >= 1.0:
+            found.append(Verdict(
+                "comm_heavy", score,
+                f"collectives take {100 * sig.comm_frac:.0f}% of traced device "
+                f"wall (ceiling {100 * THRESHOLDS['comm_frac']:.0f}%) — the "
+                "sharding plan spends the chips on the wire",
+                sig.evidence.get("comm_heavy", [])))
+    return found
+
+
+def diagnose(signals_or_events) -> Diagnosis:
+    """Rank the verdicts for a run. Accepts :class:`Signals` (the trainer's
+    live path) or a parsed event list (the offline path). Always returns
+    at least one verdict — ``healthy`` with the goodput headline as its
+    evidence when nothing fires."""
+    sig = (signals_or_events if isinstance(signals_or_events, Signals)
+           else extract_signals(list(signals_or_events)))
+    found = sorted(_verdicts(sig), key=lambda v: -v.score)
+    if not found:
+        fr = steady_fractions(sig.goodput_seconds or {})
+        found = [Verdict(
+            "healthy", 0.0,
+            f"no bottleneck over threshold (steady-state productive fraction "
+            f"{100 * fr.get('productive_step', 0.0):.0f}%)",
+            [dict(metric="productive_frac_steady",
+                  value=round(fr.get("productive_step", 0.0), 4), timeline="goodput")])]
+    return Diagnosis(found, sig)
+
+
+def scalar_fields(sig: Signals) -> dict:
+    """The live-dashboard projection: per-verdict severity scores (0.0 when
+    the rule is quiet) + ``healthy`` as 1.0/0.0 — written at epoch end
+    under the ``doctor/`` TensorBoard prefix so dashboards see what the
+    offline doctor would say. Floats only (the MetricsWriter contract)."""
+    scores = {k: 0.0 for k in VERDICTS if k != "healthy"}
+    for v in _verdicts(sig):
+        scores[v.kind] = max(scores[v.kind], float(v.score))
+    scores["healthy"] = 0.0 if any(s >= 1.0 for s in scores.values()) else 1.0
+    return scores

@@ -26,8 +26,16 @@ DP<->FSDP elasticity path of docs/parallelism.md), and elastic restores
 mesh axes and device counts, old/new grad-accumulation factors, the re-plan
 reason, and whether the mesh was re-planned or explicitly overridden,
 emitted by the Trainer after a topology-changed restore; the N!=M elastic
-path of docs/fault_tolerance.md) — as one JSON object per line,
-machine-readable and append-only.
+path of docs/fault_tolerance.md), and run-doctor verdicts (``run_doctor``:
+the ranked bottleneck diagnosis — top verdict, per-verdict severity
+scores, steady-state goodput fractions — emitted by
+``scripts/run_doctor.py --events``; the ``anomaly`` kind vocabulary also
+includes ``straggler``, the slowest-chip-ratio detector of
+``telemetry/straggler.py``) — as one JSON object per line,
+machine-readable and append-only. Since schema 2 every record also
+carries ``chips`` (this process's local device ids) and ``schema``
+(:data:`SCHEMA_VERSION`), so per-chip attribution survives elastic
+topology changes and consumers can detect vocabularies they predate.
 
 Conventions:
 
@@ -61,7 +69,15 @@ from typing import Any, Iterator
 
 import jax
 
-__all__ = ["EventLog", "read_events"]
+__all__ = ["EventLog", "SCHEMA_VERSION", "read_events"]
+
+# Record-schema version, stamped on every record as ``schema`` so offline
+# consumers (the timeline exporter, the run doctor, dashboards) can detect
+# a vocabulary they predate instead of misparsing it. History:
+#   1 — implicit (PR 4-12 records carry no ``schema`` field);
+#   2 — this field + ``chips`` identity + straggler/goodput-snapshot
+#       window/epoch fields (ISSUE 13).
+SCHEMA_VERSION = 2
 
 
 def _jsonable(value: Any) -> Any:
@@ -104,6 +120,15 @@ class EventLog:
         self.process = proc
         self.enabled = path is not None and proc == 0
         self._host = socket.gethostname()
+        # Chip identity (ISSUE 13): the local device ids this process owns,
+        # as one compact string stamped on every record — so per-chip
+        # attribution (straggler skew, memory skew) stays coherent across
+        # an elastic N->M resume, where the SAME appended log suddenly
+        # describes a different topology. Resolved lazily at the first
+        # enabled emit: a disabled log (telemetry off / non-zero rank) must
+        # not force jax backend initialization beyond what the
+        # process_index read above already did.
+        self._chips: str | None = None
         # Emits may come from the async-checkpoint commit worker as well as
         # the main thread; timestamping AND writing under one lock keeps the
         # file's t_mono stream nondecreasing (two threads reading the clock
@@ -134,6 +159,11 @@ class EventLog:
         disabled). Field values are coerced to JSON-safe scalars."""
         if not self.enabled or self._dead:
             return None
+        if self._chips is None:
+            try:
+                self._chips = ",".join(str(d.id) for d in jax.local_devices())
+            except RuntimeError:
+                self._chips = ""  # backend unavailable: identity degrades, log lives
         with self._emit_lock:
             record = {
                 "event": str(event),
@@ -142,6 +172,8 @@ class EventLog:
                 "process": self.process,
                 "host": self._host,
                 "pid": os.getpid(),
+                "chips": self._chips,
+                "schema": SCHEMA_VERSION,
             }
             for key, value in fields.items():
                 record[str(key)] = _jsonable(value)
@@ -173,7 +205,9 @@ class EventLog:
         self.close()
 
 
-def read_events(path: str, *, strict: bool = True) -> Iterator[dict]:
+def read_events(
+    path: str, *, strict: bool = True, with_lineno: bool = False
+) -> Iterator[dict]:
     """Parse an event log back into dicts — the test/smoke-side consumer.
 
     ``strict=True`` (default) raises ``ValueError`` naming the offending
@@ -181,14 +215,18 @@ def read_events(path: str, *, strict: bool = True) -> Iterator[dict]:
     the writer regressed. ``strict=False`` skips malformed lines with a
     warning — for post-crash audits, where a torn fragment from a hard kill
     (see ``EventLog._open``'s repair) is expected and the surviving record
-    stream is the point."""
+    stream is the point. ``with_lineno=True`` yields ``(lineno, record)``
+    pairs instead — the 1-based FILE line, which a consumer citing lines
+    (the run doctor's evidence rows) needs: a yielded-record index drifts
+    past every blank/torn line the tolerant mode just skipped."""
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
-                yield json.loads(line)
+                record = json.loads(line)
+                yield (lineno, record) if with_lineno else record
             except json.JSONDecodeError as e:
                 if strict:
                     raise ValueError(

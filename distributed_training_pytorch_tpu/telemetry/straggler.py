@@ -1,0 +1,116 @@
+"""Per-chip straggler attribution at existing host sync points (ISSUE 13).
+
+Multi-device meshes (ISSUE 10) and elastic N->M resumes (ISSUE 12) made
+"which chip is slow?" a real operational question, but every timing figure
+the trainer reports is a *global* host observation: the ``log_every`` sync
+blocks until the slowest chip's work lands, so one degraded chip (thermal
+throttling, a noisy PCIe neighbor, a failing HBM stack) shows up only as
+"steps got slower" with no attribution.
+
+This module is the timing twin of the PR 8 ``live_bytes_min/max/skew``
+pattern — a per-local-device sample taken at a host sync the trainer
+already pays, adding **zero extra device syncs**:
+
+* :func:`sample_arrivals` walks one device-resident metrics array's
+  addressable shards in device order, timing ``block_until_ready`` per
+  shard. The sync point was about to block on ALL of them anyway (the
+  ``float()`` metric fetch right after); sampling merely observes *which
+  shard the host actually blocked on*. Each chip is charged its
+  **incremental** blocking time (the delta over the previous shard's
+  return — cumulative elapsed would bill every later chip for an earlier
+  chip's tail and always crown the last-sampled device the straggler): a
+  healthy SPMD window finishes near-simultaneously (all deltas ~0), while
+  a straggler chip's shard absorbs the whole tail wherever it sits in the
+  sampling order, so ``max - min`` of the per-chip deltas is the
+  host-observed **dispatch skew** of the window's slowest chip.
+* :func:`ratio` normalizes that skew by the window's per-step wall —
+  "the slowest chip effectively ran each step ``ratio``× slower than the
+  window average". Healthy ≈ 1.0 regardless of absolute step time, which
+  is what makes it a baseline-able anomaly signal: the ``straggler``
+  anomaly kind (``telemetry/anomaly.py``) fires when the ratio exceeds
+  ``factor ×`` the post-warmup **floor** (the memory-growth floor rule:
+  a floor cannot be dragged up by a slowly worsening chip).
+
+Degradation contract (the ``memory.live`` convention): fewer than two
+addressable shards (single-chip hosts, plain-CPU smoke runs) or a
+non-Array metric return ``{}`` — the window records simply omit the
+fields, and the detector never fires on an absent value.
+
+Identity: every event record already carries ``host``/``process``/``pid``
+plus the ``chips`` string (``telemetry/events.py``), and the sample names
+``slowest_chip`` by global device id — so attribution stays coherent when
+an elastic resume re-plans the topology mid-job (the resumed attempt's
+records carry the NEW chip set; the flight log's append-across-restarts
+property keeps both attempts' attributions side by side).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["FIELDS", "ratio", "sample_arrivals"]
+
+# The per-window fields a successful sample contributes to the `window`
+# event (docs/observability.md vocabulary).
+FIELDS = (
+    "chip_wall_ms_min",
+    "chip_wall_ms_max",
+    "chip_skew_ms",
+    "slowest_chip",
+    "chips_sampled",
+)
+
+
+def sample_arrivals(metric_tree) -> dict:
+    """Per-chip arrival sample off one window's device-resident metrics.
+
+    ``metric_tree`` is the last executed unit's metrics pytree (device
+    scalars, replicated over the mesh — every local device holds an
+    addressable shard). Blocks on each shard in device-id order, charging
+    each device the INCREMENTAL wall its shard kept the host blocked
+    beyond the previous shard's return (see module doc: cumulative
+    elapsed misattributes the tail). The TOTAL blocking time is what the
+    sync's metric fetch would have paid anyway; only the per-device split
+    is new information.
+
+    Returns the :data:`FIELDS` dict, or ``{}`` when there are fewer than
+    two addressable shards to compare (nothing to attribute)."""
+    import jax
+
+    leaves = jax.tree.leaves(metric_tree)
+    arr = leaves[0] if leaves else None
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return {}
+    shards = sorted(shards, key=lambda s: s.device.id)
+    prev = time.perf_counter()
+    waits = []
+    for shard in shards:
+        try:
+            shard.data.block_until_ready()
+        except (AttributeError, RuntimeError):
+            return {}  # a backend without per-shard blocking: degrade, never guess
+        now = time.perf_counter()
+        waits.append((now - prev, shard.device.id))
+        prev = now
+    lo_ms = min(w for w, _ in waits) * 1e3
+    hi_ms, slowest = max(waits)
+    hi_ms *= 1e3
+    return {
+        "chip_wall_ms_min": lo_ms,
+        "chip_wall_ms_max": hi_ms,
+        "chip_skew_ms": hi_ms - lo_ms,
+        "slowest_chip": int(slowest),
+        "chips_sampled": len(waits),
+    }
+
+
+def ratio(skew_ms: float, step_ms: float) -> float:
+    """Slowest-chip ratio: ``1 + skew / step`` — how much slower the
+    slowest chip effectively ran each of the window's steps than the
+    window-average step wall. 1.0 = perfectly synchronous; 2.0 = one chip
+    cost the window a full extra step-time. Normalizing by step wall makes
+    the figure comparable across models/batch sizes (absolute skew is
+    not), which is what the floor-baselined ``straggler`` anomaly needs."""
+    step_ms = max(float(step_ms), 1e-9)
+    return 1.0 + max(float(skew_ms), 0.0) / step_ms

@@ -1,0 +1,318 @@
+"""Unified run timeline: one Chrome/Perfetto trace from a run's telemetry.
+
+PR 4 (events/goodput), PR 5 (async checkpoint commits), PR 6 (profile
+captures), and PR 8 (live memory) each write a rich stream into the same
+JSONL flight log — but they stay *columns*, and diagnosing a stall means a
+human cross-reading three vocabularies. This module merges a run
+directory's event log onto the one monotonic clock ``events.py`` already
+stamps (``t_mono``) and exports it as **trace-event JSON** (the
+``chrome://tracing`` / Perfetto / ``about:tracing`` wire format), so "open
+the trace, see the stall" replaces grep:
+
+========================  ==================================================
+track                     contents
+========================  ==================================================
+``steps``                 one span per ``window`` record (the ``log_every``
+                          cadence): duration = steps x step_ms, args carry
+                          mfu / live-memory / straggler fields
+``epochs``                one span per ``epoch_end`` (windows nest inside
+                          it visually; kept on its own track so every
+                          track's spans stay non-overlapping)
+``goodput``               the wall-time partition re-laid as spans: between
+                          consecutive cumulative ``goodput_seconds``
+                          snapshots (run_start / epoch_end / run_end), each
+                          bucket's delta becomes one span — so summing span
+                          durations per bucket re-derives the meter's
+                          fractions exactly (CI-gated in telemetry_smoke)
+``goodput async``         ``checkpoint_async`` deltas (background commit
+                          wall — overlapped with training, so it cannot sit
+                          in the sequential main-thread partition)
+``checkpoint``            hot-loop save stalls: async snapshot spans
+                          (``snapshot_ms``) and synchronous save spans
+                          (``save_ms``)
+``committer``             the async committer thread as its own track:
+                          ``queued:<name>`` (snapshot landed -> commit
+                          started) and ``commit:<name>`` (``commit_ms``)
+                          spans — a checkpoint's snapshot->queued->
+                          committing->committed lifecycle reads left to
+                          right across the two tracks
+``profile``               the ``profile_capture`` traced window
+                          (``span_us``), args carry the StepProfile
+                          category fractions + dispatch-gap audit
+``markers``               instants for everything narrative: compile,
+                          preemption, fault_injection, anomaly,
+                          loss_scale_backoff, hung_step, restore/reshard/
+                          elastic events, memory_preflight, gate verdicts
+counters                  ``live_bytes`` and ``chip_skew_ms`` as counter
+                          series (the memory-leak ramp and straggler skew
+                          are visible as line plots above the spans)
+========================  ==================================================
+
+Every track's spans are **monotone and non-overlapping by construction**
+(:class:`_Track` trims a span that would start before its predecessor
+ended — measured durations and event timestamps come from different
+clock reads, so sub-ms overhangs are expected), and the whole file is
+strict JSON (``events._jsonable`` already de-NaN'd the inputs). Load it in
+Perfetto (ui.perfetto.dev), ``chrome://tracing``, or re-parse it with
+stdlib ``json`` — the doctor (``telemetry/doctor.py``) and the tests do
+the latter.
+
+Export ritual (docs/observability.md): ``scripts/run_doctor.py <run_dir>
+--timeline`` or :func:`export_timeline` directly; the file lands next to
+the event log as ``telemetry/timeline.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from distributed_training_pytorch_tpu.telemetry.events import read_events
+from distributed_training_pytorch_tpu.telemetry.goodput import BUCKETS
+
+__all__ = [
+    "TRACKS",
+    "build_timeline",
+    "export_timeline",
+    "load_run_events",
+    "span_bucket_seconds",
+]
+
+# Stable thread ids per track (trace-event `tid`; named via "M" metadata
+# records). One pid per writing process — a resumed run's records keep
+# their own pid, so each attempt lays out as its own process group.
+TRACKS = {
+    "steps": 1,
+    "epochs": 2,
+    "goodput": 3,
+    "goodput async": 4,
+    "checkpoint": 5,
+    "committer": 6,
+    "profile": 7,
+    "markers": 8,
+}
+
+# Event kinds that become instant markers (everything narrative; span-
+# bearing kinds are handled individually). Unknown kinds fall through to
+# markers too — a future event kind shows up in the trace by default
+# instead of silently vanishing.
+_COMMON_FIELDS = ("event", "t_wall", "t_mono", "process", "host", "pid", "chips", "schema")
+
+
+def load_run_events(run_dir: str) -> list[dict]:
+    """Read a run directory's (or a direct ``.jsonl`` path's) event log,
+    tolerant of a torn last line (post-crash audits are a primary
+    consumer). Each record gains a ``_line`` field — the 1-based position
+    in the file — so doctor evidence and timeline args can cite it."""
+    path = run_dir
+    if os.path.isdir(run_dir):
+        path = os.path.join(run_dir, "telemetry", "events.jsonl")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no event log at {path} — was the run telemetry-off? "
+            "(Trainer(telemetry='on') writes <save_folder>/telemetry/events.jsonl)"
+        )
+    events = []
+    for lineno, rec in read_events(path, strict=False, with_lineno=True):
+        rec["_line"] = lineno  # the FILE line — stable past torn/blank lines
+        events.append(rec)
+    return events
+
+
+class _Track:
+    """One (pid, tid) span lane with the non-overlap invariant enforced."""
+
+    def __init__(self, out: list, pid, tid: int):
+        self._out = out
+        self._pid = pid
+        self._tid = tid
+        self._cursor = None  # end (us) of the last span laid
+
+    def span(self, name: str, end_us: float, dur_us: float, args: dict | None = None):
+        dur_us = max(float(dur_us), 0.0)
+        ts = end_us - dur_us
+        if self._cursor is not None and ts < self._cursor:
+            # Trim the overhang: measured durations and the record's
+            # timestamp come from different clock reads, so a span can
+            # claim to start slightly before its predecessor ended. Keep
+            # the END anchored (the timestamped fact) and shorten.
+            ts = min(self._cursor, end_us)
+            dur_us = end_us - ts
+        self._cursor = ts + dur_us
+        ev = {"name": name, "ph": "X", "ts": ts, "dur": dur_us,
+              "pid": self._pid, "tid": self._tid}
+        if args:
+            ev["args"] = args
+        self._out.append(ev)
+
+
+def _args(rec: dict) -> dict:
+    return {k: v for k, v in rec.items()
+            if k not in _COMMON_FIELDS and not k.startswith("_")}
+
+
+def build_timeline(events: list[dict]) -> dict:
+    """Merge parsed event records into a trace-event dict (see module doc).
+
+    ``t_mono`` seconds map to trace ``ts`` microseconds verbatim — all
+    records of one process already share that clock, which is the whole
+    reason ``events.py`` stamps it."""
+    out: list[dict] = []
+    pids = []
+    tracks: dict[tuple, _Track] = {}
+
+    def track(pid, name: str) -> _Track:
+        key = (pid, name)
+        if key not in tracks:
+            tracks[key] = _Track(out, pid, TRACKS[name])
+        return tracks[key]
+
+    def counter(pid, t_us, name, value):
+        out.append({"name": name, "ph": "C", "ts": t_us, "pid": pid,
+                    "args": {name: float(value)}})
+
+    # Per-pid goodput snapshot chain + pending async-save handoffs. The
+    # goodput lanes advance on their own continuous cursors (seeded at the
+    # first snapshot's timestamp) rather than re-anchoring to each record's
+    # t_mono: the meter's ticks and the record's emit timestamp are
+    # different clock reads, and re-anchoring would force sub-ms trims
+    # whose lost microseconds break the exact span->fraction re-derivation
+    # the smoke gate checks. Alignment drift vs the other tracks stays
+    # bounded by the emit-vs-tick offset (sub-ms); durations stay EXACT.
+    last_goodput: dict = {}
+    goodput_cursor: dict = {}
+    async_cursor: dict = {}
+    pending_snapshot: dict = {}
+
+    for rec in sorted(events, key=lambda r: (r.get("pid", 0), r.get("t_mono", 0.0))):
+        kind = rec.get("event")
+        t = rec.get("t_mono")
+        if kind is None or t is None:
+            continue
+        pid = rec.get("pid", 0)
+        if pid not in pids:
+            pids.append(pid)
+        t_us = float(t) * 1e6
+        args = _args(rec)
+        args["line"] = rec.get("_line")
+
+        # -- goodput partition: cumulative snapshot -> per-bucket spans ----
+        snap = rec.get("goodput_seconds")
+        if isinstance(snap, dict):
+            prev = last_goodput.get(pid)
+            if prev is None:
+                goodput_cursor[pid] = async_cursor[pid] = t_us
+            else:
+                for bucket in BUCKETS:
+                    delta = float(snap.get(bucket, 0.0)) - float(prev.get(bucket, 0.0))
+                    if delta <= 0.0:
+                        continue
+                    dur = delta * 1e6
+                    if bucket == "checkpoint_async":
+                        # Overlapped with training: its own lane (it would
+                        # double-lay wall the main partition already covers).
+                        track(pid, "goodput async").span(
+                            bucket, async_cursor[pid] + dur, dur,
+                            {"line": rec.get("_line")},
+                        )
+                        async_cursor[pid] += dur
+                    else:
+                        track(pid, "goodput").span(
+                            bucket, goodput_cursor[pid] + dur, dur
+                        )
+                        goodput_cursor[pid] += dur
+            last_goodput[pid] = dict(snap)
+
+        # -- span-bearing kinds -------------------------------------------
+        if kind == "window":
+            steps = float(rec.get("steps", 0) or 0)
+            step_ms = float(rec.get("step_ms", 0.0) or 0.0)
+            track(pid, "steps").span(
+                f"window@{rec.get('step_in_epoch')}", t_us, steps * step_ms * 1e3, args
+            )
+            if rec.get("live_bytes") is not None:
+                counter(pid, t_us, "live_bytes", rec["live_bytes"])
+            if rec.get("chip_skew_ms") is not None:
+                counter(pid, t_us, "chip_skew_ms", rec["chip_skew_ms"])
+            continue
+        if kind == "epoch_end":
+            track(pid, "epochs").span(
+                f"epoch {rec.get('epoch')}", t_us, float(rec.get("wall_s", 0.0)) * 1e6, args
+            )
+            if rec.get("live_bytes") is not None:
+                counter(pid, t_us, "live_bytes", rec["live_bytes"])
+            continue
+        if kind == "checkpoint_save":
+            name = str(rec.get("name", "ckpt"))
+            if rec.get("snapshot_ms") is not None:  # async: the hot-loop stall
+                track(pid, "checkpoint").span(
+                    f"snapshot:{name}", t_us, float(rec["snapshot_ms"]) * 1e3, args
+                )
+                pending_snapshot[(pid, name)] = t_us
+            elif rec.get("save_ms") is not None:  # sync/emergency: full stall
+                track(pid, "checkpoint").span(
+                    f"save:{name}", t_us, float(rec["save_ms"]) * 1e3, args
+                )
+            else:
+                out.append({"name": f"save:{name}", "ph": "i", "ts": t_us, "s": "t",
+                            "pid": pid, "tid": TRACKS["checkpoint"], "args": args})
+            continue
+        if kind == "checkpoint_commit":
+            name = str(rec.get("name", "ckpt"))
+            commit_us = float(rec.get("commit_ms", 0.0) or 0.0) * 1e3
+            queued_from = pending_snapshot.pop((pid, name), None)
+            commit_start = t_us - commit_us
+            if queued_from is not None and commit_start > queued_from:
+                track(pid, "committer").span(
+                    f"queued:{name}", commit_start, commit_start - queued_from
+                )
+            track(pid, "committer").span(f"commit:{name}", t_us, commit_us, args)
+            continue
+        if kind == "profile_capture" and rec.get("span_us") is not None:
+            track(pid, "profile").span("profile_capture", t_us, float(rec["span_us"]), args)
+            continue
+
+        # -- everything else: a narrative instant marker ------------------
+        out.append({"name": str(kind), "ph": "i", "ts": t_us, "s": "t",
+                    "pid": pid, "tid": TRACKS["markers"], "args": args})
+
+    meta = []
+    for pid in pids:
+        host = next((r.get("host") for r in events if r.get("pid") == pid), None)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"pid {pid}" + (f" @ {host}" if host else "")}})
+        for name, tid in TRACKS.items():
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                         "args": {"name": name}})
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def span_bucket_seconds(trace: dict) -> dict:
+    """Re-derive goodput bucket seconds from the exported goodput tracks —
+    the independent consumer-side check (telemetry_smoke gates that these
+    re-derive the meter's fractions within epsilon): sum span durations per
+    bucket name over the ``goodput`` + ``goodput async`` lanes."""
+    lanes = {TRACKS["goodput"], TRACKS["goodput async"]}
+    totals = {b: 0.0 for b in BUCKETS}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "X" and ev.get("tid") in lanes and ev.get("name") in totals:
+            totals[ev["name"]] += float(ev.get("dur", 0.0)) / 1e6
+    return totals
+
+
+def export_timeline(run_dir: str, out_path: str | None = None) -> tuple[dict, str]:
+    """Read a run directory's event log, build the trace, write it as
+    strict JSON. Returns ``(trace_dict, written_path)``. Default output:
+    ``<run_dir>/telemetry/timeline.json`` (next to the event log it was
+    derived from; for a direct ``.jsonl`` input, ``<stem>.timeline.json``)."""
+    events = load_run_events(run_dir)
+    trace = build_timeline(events)
+    if out_path is None:
+        if os.path.isdir(run_dir):
+            out_path = os.path.join(run_dir, "telemetry", "timeline.json")
+        else:
+            out_path = os.path.splitext(run_dir)[0] + ".timeline.json"
+    with open(out_path, "w", encoding="utf-8") as f:  # jaxlint: disable=file-write-without-rank-gate -- offline export CLI over a finished run dir, not a training-job writer
+        json.dump(trace, f)
+        f.write("\n")
+    return trace, out_path

@@ -89,7 +89,9 @@ from distributed_training_pytorch_tpu.telemetry import (
     GoodputMeter,
     resolve_telemetry,
 )
+from distributed_training_pytorch_tpu.telemetry import doctor as telemetry_doctor
 from distributed_training_pytorch_tpu.telemetry import mfu as telemetry_mfu
+from distributed_training_pytorch_tpu.telemetry import straggler as straggler_lib
 from distributed_training_pytorch_tpu.train import (
     NonFiniteLossError,
     TrainEngine,
@@ -346,6 +348,23 @@ class Trainer:
             self.goodput = None
             self.anomaly_detector = None
             self._flops_per_step = None
+        # Straggler attribution (ISSUE 13; telemetry/straggler.py): per-chip
+        # arrival-skew fields sampled at the log_every syncs, the live
+        # inputs to the doctor's `straggler` verdict. Off (or telemetry
+        # off) keeps the sync path byte-identical to the historical one.
+        self._straggler_on = self.telemetry is not None and getattr(
+            self.telemetry, "straggler", False
+        )
+        self._last_straggler: dict | None = None
+        self._max_straggler_ratio: float | None = None
+        # Live doctor signals (telemetry/doctor.py): per-kind anomaly
+        # counts, hung steps, and steady-state retraces, accumulated where
+        # the trainer already observes each fact — the epoch-end `doctor/*`
+        # TensorBoard scalars project them through the same rules the
+        # offline run doctor applies to the event log.
+        self._anomaly_counts: dict[str, int] = {}
+        self._hung_steps = 0
+        self._late_compiles = 0
         self._peak_flops = 0.0  # finalized after mesh selection below
         # Recovery skips (restore_latest_valid / the resume peek walking past
         # a corrupt checkpoint) land in the event log as `checkpoint_rejected`
@@ -626,8 +645,7 @@ class Trainer:
             # guarded like run_end: the field build includes an
             # int(self.state.step) device fetch the telemetry-off
             # (historical) path must not pay
-            self.events.emit(
-                "run_start",
+            fields = dict(
                 epoch=self.cur_epoch,
                 max_epoch=self.max_epoch,
                 step=int(self.state.step),
@@ -639,6 +657,13 @@ class Trainer:
                 chain_steps=self.chain_steps,
                 compute_dtype=str(jnp.dtype(self.precision.compute_dtype)),
             )
+            if self.goodput is not None:
+                # Cumulative-counter snapshot (zero on a cold start, the
+                # carried totals on a resume): the timeline exporter
+                # anchors its goodput-span chain here, so the spans cover
+                # exactly THIS attempt's wall.
+                fields["goodput_seconds"] = self.goodput.to_state()
+            self.events.emit("run_start", **fields)
         try:
             self._train_loop()
         finally:
@@ -771,7 +796,16 @@ class Trainer:
 
         # Barrier: every queued background commit fully on disk (and any
         # commit error surfaced) before the run declares itself finished.
+        # The wait IS checkpoint stall — the hot loop is over, but the run
+        # cannot end until the commits land — so it books to `checkpoint`,
+        # not `other`: a commit backlog (slow filesystem, commit_delay_s
+        # chaos seam) must show up where the doctor's checkpoint-stall
+        # verdict looks, not vanish into epoch glue.
+        if self.goodput is not None:
+            self.goodput.tick("other")
         self.saver.flush()
+        if self.goodput is not None:
+            self.goodput.tick("checkpoint")
         self.log("Finished!")
 
     def _log_sharded_layout(self) -> None:
@@ -1006,15 +1040,19 @@ class Trainer:
         mode = "async" if (self._async_saves and not wait) else "sync"
         telemetry_meta = self._telemetry_meta()
         snapshot_s = None
+        save_s = None  # full synchronous-save stall (the sync-mode twin of
+        #                snapshot_s) — the timeline's `save:` span duration
         if best:
             if mode == "async":
                 saved, snapshot_s = self.saver.maybe_save_best(
                     metrics, self.state, epoch, telemetry=telemetry_meta
                 )
             else:
+                t_save = time.perf_counter()
                 saved = self.checkpoints.maybe_save_best(
                     metrics, self.state, epoch, telemetry=telemetry_meta
                 )
+                save_s = time.perf_counter() - t_save
         else:
             if mode == "async":
                 snapshot_s = self.saver.save_async(
@@ -1022,7 +1060,7 @@ class Trainer:
                     loop_state=loop_state, telemetry=telemetry_meta,
                 )
             else:
-                self.saver.save_sync(
+                save_s = self.saver.save_sync(
                     name, self.state, epoch, metrics=metrics,
                     loop_state=loop_state, telemetry=telemetry_meta,
                 )
@@ -1038,6 +1076,8 @@ class Trainer:
             fields = {"name": name, "epoch": epoch, "reason": reason, "mode": mode}
             if snapshot_s is not None:
                 fields["snapshot_ms"] = snapshot_s * 1e3
+            elif save_s is not None:
+                fields["save_ms"] = save_s * 1e3
             if loop_state:
                 fields["step_in_epoch"] = int(loop_state.get("step_in_epoch", 0))
             self.events.emit("checkpoint_save", **fields)
@@ -1061,6 +1101,35 @@ class Trainer:
             if mfu is not None:
                 scalars["mfu"] = mfu
             self.metrics_writer.write(step, scalars, prefix="telemetry")
+        if self._last_straggler:
+            self.metrics_writer.write(
+                step,
+                {
+                    "skew_ms": self._last_straggler["chip_skew_ms"],
+                    "ratio": self._last_straggler["straggler_ratio"],
+                },
+                prefix="straggler",
+            )
+        # The live doctor (ISSUE 13): the same verdict rules the offline
+        # run doctor applies to the event log, projected from this run's
+        # in-memory counters — dashboards see per-verdict severity scores
+        # (>= 1.0 = over the line) without waiting for the offline pass.
+        self.metrics_writer.write(
+            step, telemetry_doctor.scalar_fields(self._doctor_signals()), prefix="doctor"
+        )
+
+    def _doctor_signals(self) -> "telemetry_doctor.Signals":
+        """The live-path :class:`telemetry.doctor.Signals` bundle — the same
+        facts :func:`telemetry.doctor.extract_signals` would distill from
+        this run's event log, read off the trainer's own counters instead
+        (no file round trip at epoch end)."""
+        return telemetry_doctor.Signals(
+            goodput_seconds=self.goodput.to_state() if self.goodput else None,
+            anomaly_counts=dict(self._anomaly_counts),
+            hung_steps=self._hung_steps,
+            max_straggler_ratio=self._max_straggler_ratio,
+            late_compiles=self._late_compiles,
+        )
 
     def _maybe_probe_mfu(self) -> None:
         """One-time XLA cost-analysis probe for the per-step FLOP count
@@ -1197,6 +1266,7 @@ class Trainer:
         if not anomalies:
             return
         for a in anomalies:
+            self._anomaly_counts[a.kind] = self._anomaly_counts.get(a.kind, 0) + 1
             self.events.emit(
                 "anomaly",
                 kind=a.kind,
@@ -1333,6 +1403,15 @@ class Trainer:
         rollback_fetch = skip_steps > 0
         tele_sync = [t0, 0]  # (perf_counter, executed) at the last sync point
         trace_base = [0]  # trace_counts total before the in-flight unit
+        # Trace totals at the last sync point / epoch start: a window (or
+        # epoch) that paid XLA compile has a known-skewed wall, so its
+        # step_time is withheld from the anomaly detector's EWMA — the
+        # compile-polluted first windows would otherwise seed the baseline
+        # minutes high and mask real regressions for the rest of the run
+        # (warmup alone only delays firing; it does not keep the poison
+        # out of the baseline).
+        sync_trace = [sum(self.engine.trace_counts.values())]
+        epoch_trace_start = sync_trace[0]
         num_batches = len(self.train_dataloader)
         chain = self.chain_steps
         # Resume skip happens at the loader's INDEX level when it can
@@ -1380,6 +1459,12 @@ class Trainer:
             # and, multi-host only, the preemption vote (_preemption_requested).
             nonlocal synced_entries, synced_steps
             n_last, last = collected[-1]
+            # Straggler sample FIRST (ISSUE 13): the float() fetches below
+            # are about to block this host on every chip's window results —
+            # sampling per-shard arrival order now observes WHICH chip the
+            # sync is waiting on, at zero extra device syncs (the total
+            # blocking time is the same either way).
+            strag = straggler_lib.sample_arrivals(last) if self._straggler_on else {}
             m = {
                 k: float(v[-1]) if n_last > 1 else float(v) for k, v in last.items()
             }
@@ -1427,12 +1512,26 @@ class Trainer:
                     )
                     self._last_step_ms = report["step_ms"]
                     mem_fields = self._live_memory_fields()
+                    if strag:
+                        # Normalize skew by this window's step wall — the
+                        # floor-baselined anomaly signal and the doctor's
+                        # attribution input.
+                        strag["straggler_ratio"] = straggler_lib.ratio(
+                            strag["chip_skew_ms"], report["step_ms"]
+                        )
+                        self._last_straggler = strag
+                        if (
+                            self._max_straggler_ratio is None
+                            or strag["straggler_ratio"] > self._max_straggler_ratio
+                        ):
+                            self._max_straggler_ratio = strag["straggler_ratio"]
                     self.events.emit(
                         "window",
                         epoch=epoch,
                         step_in_epoch=step_in_epoch,
                         **report,
                         **mem_fields,
+                        **strag,
                     )
                     scale = m.get("loss_scale")
                     if scale is not None:
@@ -1449,13 +1548,22 @@ class Trainer:
                             )
                         self._last_scale_seen = scale
                     if self.anomaly_detector is not None:
+                        now_traced = sum(self.engine.trace_counts.values())
+                        window_compiled = now_traced > sync_trace[0]
+                        sync_trace[0] = now_traced
                         self._report_anomalies(
                             self.anomaly_detector.observe(
                                 step_in_epoch,
                                 loss=m.get("loss", m.get("ce_loss")),
                                 grad_norm=m.get("grad_norm"),
-                                step_time=report["step_ms"] / 1e3,
+                                # None (absent) when this window paid
+                                # compile: never fires, never feeds the
+                                # baseline (see sync_trace above).
+                                step_time=None
+                                if window_compiled
+                                else report["step_ms"] / 1e3,
                                 live_bytes=mem_fields.get("live_bytes"),
+                                straggler_ratio=strag.get("straggler_ratio"),
                             ),
                             epoch=epoch,
                             step_in_epoch=step_in_epoch,
@@ -1472,6 +1580,11 @@ class Trainer:
             if tm is not None:
                 tm.tick("compile" if traced else "productive_step")
             if traced:
+                if epoch >= 1:
+                    # Epoch 0 compiles are warmup; a compile in the steady
+                    # state is the retrace signature the doctor's
+                    # compile_bound verdict keys on.
+                    self._late_compiles += 1
                 self.events.emit(
                     "compile",
                     epoch=epoch,
@@ -1666,6 +1779,17 @@ class Trainer:
                 if k in out
             }
             mem_fields = self._live_memory_fields()
+            epoch_fields = {}
+            if self.goodput is not None:
+                # Cumulative goodput snapshot per epoch: the timeline
+                # exporter turns consecutive snapshots into per-bucket
+                # spans, and the offline doctor reads the last one.
+                epoch_fields["goodput_seconds"] = self.goodput.to_state()
+            if self._last_straggler:
+                epoch_fields["chip_skew_ms"] = self._last_straggler["chip_skew_ms"]
+                epoch_fields["straggler_ratio"] = self._last_straggler[
+                    "straggler_ratio"
+                ]
             self.events.emit(
                 "epoch_end",
                 epoch=epoch,
@@ -1674,14 +1798,23 @@ class Trainer:
                 **report,
                 **health,
                 **mem_fields,
+                **epoch_fields,
             )
             if self.anomaly_detector is not None:
+                epoch_compiled = (
+                    sum(self.engine.trace_counts.values()) > epoch_trace_start
+                )
                 self._report_anomalies(
                     self.anomaly_detector.observe(
                         step_in_epoch,
                         loss=out.get("loss", out.get("ce_loss")),
                         grad_norm=out.get("grad_norm"),
-                        step_time=report["step_ms"] / 1e3,
+                        # An epoch that paid compile (epoch 0, or a resume
+                        # retrace) reports a compile-diluted mean step
+                        # time: withheld, like the per-window rule above.
+                        step_time=None
+                        if epoch_compiled
+                        else report["step_ms"] / 1e3,
                         live_bytes=mem_fields.get("live_bytes"),
                     ),
                     epoch=epoch,
@@ -1800,6 +1933,7 @@ class Trainer:
             )
             os._exit(75)  # EX_TEMPFAIL
         self._hung_once = True
+        self._hung_steps += 1
         self.log(
             f"watchdog: no step completed in {timeout}s — forcing a "
             "preemption-style resumable save",

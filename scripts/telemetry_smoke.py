@@ -19,7 +19,12 @@ scan outputs), then asserts the subsystem's core contracts:
 * the run is traced with ``profile=ProfileConfig(steps=2)`` (ISSUE 6): the
   capture completes on a real digits run, its ``StepProfile`` **category
   fractions sum to 1 ± ε**, and the ``profile_capture`` event lands in the
-  log with the attribution summary.
+  log with the attribution summary;
+* the exported **timeline** (ISSUE 13, ``telemetry.timeline``) is valid
+  trace-event JSON (stdlib re-parse of the written file), every lane's
+  spans are monotone and non-overlapping, and summing the goodput lanes'
+  span durations **re-derives the meter's bucket fractions within ε** —
+  the trace is the partition, not a picture of it.
 
 Fails fast (nonzero exit) so ``scripts/verify.sh`` catches observability
 regressions the way the retrace/precision gates catch theirs.
@@ -182,6 +187,43 @@ def main() -> int:
             errors.append(f"expected exactly 1 profile_capture event, got {len(captures)}")
         elif "categories" not in captures[0]:
             errors.append(f"profile_capture event carries no attribution: {captures[0]}")
+
+        # -- timeline export: strict JSON + goodput re-derivation (ISSUE 13)
+        import json
+
+        from distributed_training_pytorch_tpu.telemetry import timeline as timeline_lib
+
+        try:
+            _, tl_path = timeline_lib.export_timeline(tmp)
+            with open(tl_path, encoding="utf-8") as f:
+                trace = json.load(f)  # stdlib re-parse: strict-JSON contract
+        except ValueError as e:
+            trace = None
+            errors.append(f"timeline export is not valid JSON: {e}")
+        if trace is not None:
+            spans = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+            if not spans:
+                errors.append("timeline has no spans")
+            lanes = {}
+            for ev in spans:
+                lanes.setdefault((ev["pid"], ev["tid"]), []).append(ev)
+            for key, lane in lanes.items():
+                lane.sort(key=lambda e: e["ts"])
+                for a, b in zip(lane, lane[1:], strict=False):
+                    if b["ts"] < a["ts"] + a["dur"] - 1e-3:
+                        errors.append(
+                            f"timeline lane {key} spans overlap: {a} then {b}"
+                        )
+                        break
+            derived = timeline_lib.span_bucket_seconds(trace)
+            total_d = sum(derived.values())
+            for bucket, frac in fractions.items():
+                got = derived.get(bucket, 0.0) / max(total_d, 1e-12)
+                if abs(got - frac) > 1e-6:
+                    errors.append(
+                        f"timeline {bucket} span fraction {got:.6f} != goodput "
+                        f"fraction {frac:.6f}"
+                    )
 
         if errors:
             print("TELEMETRY SMOKE FAILED:", file=sys.stderr)

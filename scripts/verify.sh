@@ -84,12 +84,26 @@
 # path) fails here. The gate's own teeth are tested on every run: a
 # deliberate 3x injected slowdown must make it FAIL.
 #
-# Stage 11 is the ROADMAP.md tier-1 command verbatim.
+# Stage 11 is the data-wait gate (ISSUE 13 / ROADMAP item 5): a short real
+# digits Trainer run with telemetry on, gating the steady-state data_wait
+# goodput fraction against the committed PERF_BASELINE.json ceiling — the
+# input pipeline cannot quietly become the bottleneck. Teeth: an injected
+# per-batch loader sleep (the ShardedLoader.load_delay_s seam) must FAIL.
+#
+# Stage 12 is the run-doctor self-test (ISSUE 13; docs/observability.md):
+# four short digits runs — a clean twin plus three with a known bottleneck
+# injected through existing seams (loader sleep, async commit_delay_s,
+# FaultPlan hang) — and the doctor must name each culprit (data_bound /
+# checkpoint_stall / straggler) and say healthy on the clean twin. The
+# clean twin's exported timeline must be valid trace-event JSON whose
+# goodput spans re-derive the meter's fractions within epsilon.
+#
+# Stage 13 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/11: import health (pytest --collect-only) =="
+echo "== stage 1/13: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -98,7 +112,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/11: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/13: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -124,25 +138,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/11: chained-dispatch retrace guard =="
+echo "== stage 3/13: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/11: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/13: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/11: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/13: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/11: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/13: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -152,26 +166,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/11: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/13: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/11: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/13: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/11: elastic chaos soak (kill on N devices, resume on M) =="
+echo "== stage 9/13: elastic chaos soak (kill on N devices, resume on M) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
   echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
   echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
   exit 11
 fi
 
-echo "== stage 10/11: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 10/13: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -183,7 +197,29 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 11/11: tier-1 test suite =="
+echo "== stage 11/13: data-wait gate (clean + injected-starvation self-test) =="
+if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait; then
+  echo "DATA-WAIT GATE FAILED — the input pipeline's steady-state data_wait"
+  echo "fraction exceeds the PERF_BASELINE.json ceiling (ROADMAP item 5)"
+  echo "(legitimate pipeline change? re-record: scripts/perf_gate.py --data-wait --update)"
+  exit 12
+fi
+if JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait --inject-data-wait 0.05 \
+    > /tmp/_data_wait_selftest.log 2>&1; then
+  echo "DATA-WAIT GATE SELF-TEST FAILED — an injected starved pipeline PASSED the gate"
+  exit 12
+fi
+echo "data-wait gate self-test OK: injected loader sleep correctly failed"
+
+echo "== stage 12/13: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
+if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
+  echo "RUN DOCTOR SELF-TEST FAILED — an injected bottleneck was misdiagnosed,"
+  echo "the clean twin was not healthy, or the exported timeline broke the"
+  echo "goodput span re-derivation (docs/observability.md)"
+  exit 13
+fi
+
+echo "== stage 13/13: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

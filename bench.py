@@ -43,6 +43,7 @@ from distributed_training_pytorch_tpu.ops import cross_entropy_loss, accuracy
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
 from distributed_training_pytorch_tpu.telemetry import GoodputMeter
 from distributed_training_pytorch_tpu.telemetry import mfu as mfu_lib
+from distributed_training_pytorch_tpu.telemetry.provenance import provenance_fields
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
 from distributed_training_pytorch_tpu.utils import hlo_flops
 from distributed_training_pytorch_tpu.utils.tpu import enable_fast_rng, tpu_compiler_options
@@ -1060,6 +1061,17 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
     mfu_exec = mfu_lib.mfu_value(exec_step_flops or 0.0, dt, peak)
     mfu_xla = mfu_lib.mfu_value(xla_step_flops, dt, peak) or 0.0
 
+    # Provenance stamp (ISSUE 14): git SHA + jax/jaxlib + effective
+    # XLA_FLAGS + the program identity — without it, a BENCH_r line is not
+    # attributable and run_compare/bench_history cannot tell two configs
+    # apart (four flat rounds went undiagnosed partly for this reason).
+    provenance = provenance_fields(
+        mesh=setup["mesh_spec"],
+        dtype=setup["dtype_name"] or "bf16",
+        chain_steps=steps if chain else 1,
+        batch=batch,
+    )
+
     print(
         json.dumps(
             {
@@ -1110,6 +1122,7 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
                 **goodput_fields,
                 **e2e,
                 **trainer_loop,
+                "provenance": provenance,
             }
         )
     )
@@ -1175,6 +1188,11 @@ def main():
                             else {}
                         ),
                         "error": (str(e).splitlines() or [type(e).__name__])[0][:300],
+                        "provenance": provenance_fields(
+                            mesh=mesh_spec,
+                            dtype=dtype_name or "bf16",
+                            batch=ctx.get("batch"),
+                        ),
                     }
                 )
             )

@@ -17,7 +17,11 @@ run as a ``scripts/verify.sh`` gate:
   static collective inventory of the partitioned single-step and chained
   programs (per-op bytes, mesh-axis attribution), an analytic expected-comm
   model with accidental-gather / model-exceeded failure modes, and a
-  ``COMM_BASELINE.json`` regression gate mirroring the perf gate's ritual.
+  ``COMM_BASELINE.json`` regression gate mirroring the perf gate's ritual;
+* ``analysis.diff`` — structural A/B diffing (ISSUE 14) on the same
+  ``compile_step_probe`` lowerings: optimized-HLO op-category/fusion-count
+  deltas and per-axis collective-inventory byte deltas with replica-group
+  changes named (``scripts/run_compare.py`` is the CLI surface).
 """
 
 from distributed_training_pytorch_tpu.analysis.generic import (
@@ -54,12 +58,26 @@ from distributed_training_pytorch_tpu.analysis.comm_audit import (
     expected_comm,
     run_comm_audit,
 )
+from distributed_training_pytorch_tpu.analysis.diff import (
+    CommDiff,
+    HloSignature,
+    HloStructuralDiff,
+    diff_comm,
+    diff_hlo,
+    hlo_signature,
+)
 from distributed_training_pytorch_tpu.analysis.waivers import Waiver, scan_waivers
 
 __all__ = [
     "CommAuditReport",
+    "CommDiff",
     "CommInventory",
     "ExpectedComm",
+    "HloSignature",
+    "HloStructuralDiff",
+    "diff_comm",
+    "diff_hlo",
+    "hlo_signature",
     "collective_inventory",
     "comm_fields",
     "expected_comm",

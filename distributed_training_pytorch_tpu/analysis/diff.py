@@ -1,0 +1,307 @@
+"""Structural program diff: what did the compiler emit DIFFERENTLY? (ISSUE 14)
+
+The profile diff (``profiling/diff.py``) says which *category of time*
+explains a step_ms change; this module answers the structural question
+underneath it, on the same ``TrainEngine.compile_step_probe`` lowerings the
+HLO and comm audits already read (abstract avals, zero execution,
+CPU-viable):
+
+* **HLO signature diff** — per-category instruction counts (through the ONE
+  shared ``profiling.categories.categorize``) and the fusion count of two
+  optimized-HLO texts. A Pallas kernel landing shows up as a conv/dot
+  instruction replaced by a custom-call; an XLA flag change shows up as a
+  fusion-count shift; a shape leak shows up as the instruction count
+  ballooning.
+* **Comm inventory diff** — two ``comm_audit.collective_inventory`` results
+  compared per mesh axis (byte deltas) and per collective op, with
+  replica-group changes *named*: a collective whose device groups moved to a
+  different axis, group count, or group size is exactly the mis-rule /
+  re-route signature the comm audit hunts within one program — here it is
+  caught *between* two programs (e.g. a sharding-rule edit silently turning
+  a tensor-axis reduce-scatter into a full all-gather).
+
+Both diffs are pure text/dataclass transforms so they unit-test on
+hand-built programs; ``scripts/run_compare.py`` exposes them on real
+lowerings via ``--hlo`` / run-dir inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distributed_training_pytorch_tpu.analysis.comm_audit import (
+    COMM_OPS,
+    CommInventory,
+)
+from distributed_training_pytorch_tpu.profiling.categories import categorize
+from distributed_training_pytorch_tpu.profiling.diff import (
+    attribute_delta,
+    describe_rows,
+)
+
+__all__ = [
+    "CommDiff",
+    "HloSignature",
+    "HloStructuralDiff",
+    "diff_comm",
+    "diff_hlo",
+    "hlo_signature",
+    "iter_instruction_opcodes",
+]
+
+
+def iter_instruction_opcodes(hlo_text: str):
+    """Yield ``(instruction_name, opcode)`` for every instruction line of an
+    (optimized or lowered) HLO text. An instruction line is
+    ``[ROOT ]%name = <type> opcode(operands...), attrs`` — the type may be a
+    parenthesized tuple with internal spaces, so the type segment is skipped
+    by balanced-paren scan, not by whitespace split."""
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        if " = " not in line:
+            continue
+        head, rhs = line.split(" = ", 1)
+        head = head.strip()
+        if head.startswith("ROOT "):
+            head = head[len("ROOT "):].strip()
+        if not head.startswith("%") and not head.replace(".", "").replace(
+            "-", ""
+        ).replace("_", "").isalnum():
+            continue
+        rhs = rhs.lstrip()
+        if rhs.startswith("("):  # tuple type: skip the balanced group
+            depth, j = 0, 0
+            while j < len(rhs):
+                if rhs[j] == "(":
+                    depth += 1
+                elif rhs[j] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            rhs = rhs[j + 1:].lstrip()
+        else:  # scalar/array type: one whitespace-delimited token
+            cut = rhs.find(" ")
+            if cut < 0:
+                continue
+            rhs = rhs[cut + 1:].lstrip()
+        paren = rhs.find("(")
+        if paren <= 0:
+            continue
+        opcode = rhs[:paren].strip()
+        # Opcode tokens are lowercase identifiers with dashes (all-reduce,
+        # get-tuple-element); anything else is a non-instruction line that
+        # happened to carry " = " (metadata, frontend attributes).
+        if not opcode or not opcode.replace("-", "").replace("_", "").isalnum():
+            continue
+        yield head, opcode
+
+
+@dataclasses.dataclass
+class HloSignature:
+    """The structural fingerprint of one optimized-HLO program."""
+
+    label: str
+    instructions: int
+    fusions: int
+    collectives: int
+    category_counts: dict  # shared-categorizer bucket -> instruction count
+    opcode_counts: dict  # raw opcode -> count (the fine-grained view)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def hlo_signature(hlo_text: str, *, label: str = "") -> HloSignature:
+    """Fingerprint an HLO text: instruction/fusion/collective counts plus
+    per-category counts through the ONE shared categorizer — so a category
+    row here and a category row in a profile report mean the same bucket."""
+    categories: dict[str, int] = {}
+    opcodes: dict[str, int] = {}
+    fusions = 0
+    collectives = 0
+    total = 0
+    for _, opcode in iter_instruction_opcodes(hlo_text):
+        total += 1
+        opcodes[opcode] = opcodes.get(opcode, 0) + 1
+        cat = categorize(opcode)
+        categories[cat] = categories.get(cat, 0) + 1
+        if opcode == "fusion":
+            fusions += 1
+        if any(opcode.startswith(c) for c in COMM_OPS):
+            collectives += 1
+    return HloSignature(
+        label=label,
+        instructions=total,
+        fusions=fusions,
+        collectives=collectives,
+        category_counts=categories,
+        opcode_counts=opcodes,
+    )
+
+
+@dataclasses.dataclass
+class HloStructuralDiff:
+    """Two program fingerprints and their ranked per-category count deltas
+    (the one ``attribute_delta`` rule — deltas sum to the total instruction
+    delta by construction)."""
+
+    before: HloSignature
+    after: HloSignature
+    category_deltas: list  # list[DeltaRow] over category_counts
+    opcode_deltas: list  # list[DeltaRow] over opcode_counts
+
+    @property
+    def instruction_delta(self) -> int:
+        return self.after.instructions - self.before.instructions
+
+    @property
+    def fusion_delta(self) -> int:
+        return self.after.fusions - self.before.fusions
+
+    @property
+    def collective_delta(self) -> int:
+        return self.after.collectives - self.before.collectives
+
+    @property
+    def identical(self) -> bool:
+        return (
+            self.instruction_delta == 0
+            and all(r.delta == 0 for r in self.opcode_deltas)
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "before": self.before.to_dict(),
+            "after": self.after.to_dict(),
+            "instruction_delta": self.instruction_delta,
+            "fusion_delta": self.fusion_delta,
+            "collective_delta": self.collective_delta,
+            "category_deltas": [r.to_dict() for r in self.category_deltas],
+            "opcode_deltas": [r.to_dict() for r in self.opcode_deltas],
+        }
+
+    def describe(self, *, top: int = 6) -> str:
+        if self.identical:
+            return (
+                f"HLO structure identical ({self.before.instructions} "
+                "instructions, same opcode mix)"
+            )
+        line = (
+            f"HLO instructions {self.before.instructions} -> "
+            f"{self.after.instructions} ({self.instruction_delta:+d}), "
+            f"fusions {self.before.fusions} -> {self.after.fusions} "
+            f"({self.fusion_delta:+d}): "
+        )
+        rows = [r for r in self.category_deltas if r.delta]
+        return line + describe_rows(rows, unit="ops", top=top, digits=0)
+
+
+def diff_hlo(before, after, *, label_before: str = "before",
+             label_after: str = "after") -> HloStructuralDiff:
+    """Structural diff of two programs — HLO texts or prebuilt
+    :class:`HloSignature` s (pass a compiled executable's ``as_text()``)."""
+    sig_b = (before if isinstance(before, HloSignature)
+             else hlo_signature(str(before), label=label_before))
+    sig_a = (after if isinstance(after, HloSignature)
+             else hlo_signature(str(after), label=label_after))
+    return HloStructuralDiff(
+        before=sig_b,
+        after=sig_a,
+        category_deltas=attribute_delta(sig_b.category_counts, sig_a.category_counts),
+        opcode_deltas=attribute_delta(sig_b.opcode_counts, sig_a.opcode_counts),
+    )
+
+
+def _axes_key(axes: tuple) -> str:
+    return "x".join(axes) if axes else "?"
+
+
+@dataclasses.dataclass
+class CommDiff:
+    """Two collective inventories compared: per-axis and per-op byte deltas
+    (ranked, the one attribution rule) plus *named* replica-group changes —
+    the collectives that appeared, vanished, or moved to different device
+    groups between the two programs."""
+
+    before: CommInventory
+    after: CommInventory
+    axis_deltas: list  # list[DeltaRow] over by_axes byte totals
+    op_deltas: list  # list[DeltaRow] over by_op byte totals
+    group_changes: list  # list[str] — named new/removed/regrouped collectives
+
+    @property
+    def total_delta(self) -> float:
+        return self.after.total_bytes - self.before.total_bytes
+
+    @property
+    def identical(self) -> bool:
+        return not self.group_changes and all(r.delta == 0 for r in self.axis_deltas)
+
+    def to_dict(self) -> dict:
+        return {
+            "before_bytes": self.before.total_bytes,
+            "after_bytes": self.after.total_bytes,
+            "total_delta_bytes": self.total_delta,
+            "axis_deltas": [r.to_dict() for r in self.axis_deltas],
+            "op_deltas": [r.to_dict() for r in self.op_deltas],
+            "group_changes": list(self.group_changes),
+        }
+
+    def describe(self, *, top: int = 6) -> str:
+        if self.identical:
+            return (
+                f"comm identical ({len(self.before.collectives)} collective(s), "
+                f"{int(self.before.total_bytes)} B/step)"
+            )
+        lines = [
+            f"comm {int(self.before.total_bytes)} -> "
+            f"{int(self.after.total_bytes)} B/step "
+            f"({self.total_delta:+.0f} B): per-axis "
+            + describe_rows(
+                [r for r in self.axis_deltas if r.delta], unit="B", top=top, digits=0
+            )
+        ]
+        for change in self.group_changes:
+            lines.append(f"  groups: {change}")
+        return "\n".join(lines)
+
+
+def diff_comm(before: CommInventory, after: CommInventory) -> CommDiff:
+    """Diff two ``collective_inventory`` results. Byte deltas are attributed
+    per mesh axis and per collective op; group changes are matched by
+    instruction name (stable for the same program lowered twice; a renamed
+    instruction reports as removed+new — which IS a structural change)."""
+    axis_rows = attribute_delta(
+        {_axes_key(a): v for a, v in before.by_axes().items()},
+        {_axes_key(a): v for a, v in after.by_axes().items()},
+    )
+    op_rows = attribute_delta(before.by_op(), after.by_op())
+
+    by_name_b = {c.name: c for c in before.collectives}
+    by_name_a = {c.name: c for c in after.collectives}
+    changes: list[str] = []
+    for name in sorted(set(by_name_b) | set(by_name_a)):
+        cb, ca = by_name_b.get(name), by_name_a.get(name)
+        if cb is None:
+            changes.append(f"NEW {ca.describe()}")
+        elif ca is None:
+            changes.append(f"REMOVED {cb.describe()}")
+        elif (cb.axes, cb.groups, cb.group_size) != (ca.axes, ca.groups, ca.group_size):
+            changes.append(
+                f"REGROUPED {name} [{ca.op}]: "
+                f"{cb.groups} group(s) of {cb.group_size} over "
+                f"{_axes_key(cb.axes)} -> {ca.groups} group(s) of "
+                f"{ca.group_size} over {_axes_key(ca.axes)}"
+            )
+        elif cb.bytes != ca.bytes:
+            changes.append(
+                f"RESIZED {name} [{ca.op}]: {int(cb.bytes)} -> {int(ca.bytes)} B"
+            )
+    return CommDiff(
+        before=before,
+        after=after,
+        axis_deltas=axis_rows,
+        op_deltas=op_rows,
+        group_changes=changes,
+    )

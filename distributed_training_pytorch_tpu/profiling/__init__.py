@@ -21,7 +21,13 @@ and keeps it from regressing silently:
   ``profile_capture`` event;
 * :mod:`~.gate`       — perf-regression gate logic behind
   ``scripts/perf_gate.py`` and the verify.sh stage (committed
-  ``PERF_BASELINE.json``, relative tolerance, CPU-viable calibrated ratio).
+  ``PERF_BASELINE.json``, relative tolerance, CPU-viable calibrated ratio);
+* :mod:`~.diff`       — the across-runs layer (ISSUE 14):
+  ``diff_profiles(before, after)`` -> :class:`ProfileDiff` with ranked
+  per-category step-delta attribution (fractions of delta sum to 1),
+  matched/new/removed op deltas and roofline shifts, plus the ONE generic
+  ``attribute_delta`` used by ``scripts/run_compare.py`` and perf_gate's
+  FAIL diagnosis.
 
 ``utils.profiling`` remains as a thin re-export shim for existing imports.
 See docs/profiling.md for the capture -> report -> act workflow.
@@ -36,6 +42,15 @@ from distributed_training_pytorch_tpu.profiling.categories import (  # noqa: F40
     CATEGORIES,
     IDLE,
     categorize,
+)
+from distributed_training_pytorch_tpu.profiling.diff import (  # noqa: F401
+    DeltaRow,
+    OpDelta,
+    ProfileDiff,
+    attribute_delta,
+    attribute_entry_delta,
+    describe_rows,
+    diff_profiles,
 )
 from distributed_training_pytorch_tpu.profiling.gate import (  # noqa: F401
     GateResult,
@@ -58,16 +73,23 @@ from distributed_training_pytorch_tpu.profiling.trace import (  # noqa: F401
 
 __all__ = [
     "CATEGORIES",
+    "DeltaRow",
     "GateResult",
     "IDLE",
+    "OpDelta",
     "OpRow",
     "ProfileConfig",
+    "ProfileDiff",
     "REPORT_FIELDS",
     "StepProfile",
     "StepTraceCapture",
     "analyze_trace",
     "annotate",
+    "attribute_delta",
+    "attribute_entry_delta",
     "categorize",
+    "describe_rows",
+    "diff_profiles",
     "flops_index",
     "latest_trace_file",
     "load_baseline",

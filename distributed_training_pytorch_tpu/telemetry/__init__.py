@@ -28,7 +28,14 @@ preemption events, loss-scale state) into one surface:
   own track, profile captures, narrative markers);
 * :mod:`~.doctor`   — the ranked bottleneck diagnosis (compile-bound /
   data-bound / checkpoint-stall / straggler / comm-heavy / healthy) shared
-  by ``scripts/run_doctor.py`` and the epoch-end ``doctor/*`` scalars.
+  by ``scripts/run_doctor.py`` and the epoch-end ``doctor/*`` scalars;
+* :mod:`~.provenance` — the ONE provenance record (git SHA, jax/jaxlib,
+  ``XLA_FLAGS``, mesh/dtype/chain_steps) stamped on bench lines, dryrun
+  entries, and ``run_start`` events so comparisons are attributable
+  (ISSUE 14);
+* :mod:`~.history`  — the committed ``BENCH_r*``/``MULTICHIP_r*`` rounds as
+  per-metric trajectories with flat-streak + regression detection
+  (``scripts/bench_history.py``; the r02→r05 plateau is the self-test).
 
 Wire-up: ``Trainer(telemetry="on")`` (or a :class:`Telemetry` instance for
 knobs); entries honor ``TELEMETRY=1``; see ``docs/observability.md``.
@@ -82,7 +89,7 @@ __all__ = [
     "window_report",
 ]
 
-# timeline/doctor/straggler are imported as submodules on demand
+# timeline/doctor/straggler/history/provenance are imported as submodules on demand
 # (``from distributed_training_pytorch_tpu.telemetry import timeline``) —
 # the trainer hot path must not pay their import, and the package root
 # stays import-light for the historical program.

@@ -31,7 +31,12 @@ the ranked bottleneck diagnosis — top verdict, per-verdict severity
 scores, steady-state goodput fractions — emitted by
 ``scripts/run_doctor.py --events``; the ``anomaly`` kind vocabulary also
 includes ``straggler``, the slowest-chip-ratio detector of
-``telemetry/straggler.py``) — as one JSON object per line,
+``telemetry/straggler.py``), and the A/B layer's records (ISSUE 14:
+``run_compare`` — an across-runs comparison's kind, clean verdict, step_ms
+delta, ranked attribution rows and provenance-mismatch keys, emitted by
+``scripts/run_compare.py --events``; ``bench_history`` — the
+committed-rounds ledger's flat streaks and regressions, emitted by
+``scripts/bench_history.py --events``) — as one JSON object per line,
 machine-readable and append-only. Since schema 2 every record also
 carries ``chips`` (this process's local device ids) and ``schema``
 (:data:`SCHEMA_VERSION`), so per-chip attribution survives elastic

@@ -1,0 +1,99 @@
+"""Run provenance stamping (ISSUE 14 satellite): who produced this number?
+
+A bench line, a dryrun entry, or a ``run_start`` event is only comparable
+to another one if both say what produced them: the git SHA, the jax/jaxlib
+versions, the effective ``XLA_FLAGS``, the mesh spec, the compute dtype,
+and the chain length. Four flat BENCH rounds went undiagnosed partly
+because nothing recorded whether r03's number even ran the same program as
+r02's. This module is the ONE provenance builder, stamped by:
+
+* ``bench.py`` — every sweep JSON line (including the OOM lines);
+* ``__graft_entry__.dryrun_multichip`` — every mesh-sweep entry;
+* the Trainer's ``run_start`` event (rank-0, telemetry-on runs).
+
+Comparison semantics (``scripts/run_compare.py`` / ``telemetry.history``):
+:data:`COMPARE_KEYS` are the *configuration* keys — two entries differing
+on any of them measure different programs and are refused without
+``--force`` (naming the keys). ``git_sha`` is deliberately NOT a compare
+key: differing code is the *point* of an A/B comparison; it is recorded so
+the report can cite which commits are being compared. Entries with no
+provenance at all (the pre-ISSUE-14 committed rounds) compare with a
+warning, not a refusal — history must stay readable backwards.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+__all__ = ["COMPARE_KEYS", "differing_keys", "provenance_fields"]
+
+# Configuration keys that must MATCH for a comparison to be meaningful.
+# git_sha is excluded on purpose (see module doc).
+COMPARE_KEYS = ("jax", "jaxlib", "xla_flags", "mesh", "dtype", "chain_steps", "batch")
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Memoized per process: the SHA cannot change mid-run, and run_start +
+# every sweep line asking would otherwise each pay a subprocess.
+_GIT_SHA: "str | None" = None
+
+
+def _git_sha() -> str:
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        try:
+            _GIT_SHA = subprocess.run(
+                ["git", "-C", _REPO_ROOT, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10, check=True,
+            ).stdout.strip() or "unknown"
+        except (OSError, subprocess.SubprocessError):
+            # No git / not a checkout (an installed wheel, a stripped CI
+            # image): provenance degrades to "unknown", never raises.
+            _GIT_SHA = "unknown"
+    return _GIT_SHA
+
+
+def provenance_fields(
+    *,
+    mesh=None,
+    dtype: "str | None" = None,
+    chain_steps: "int | None" = None,
+    batch: "int | None" = None,
+) -> dict:
+    """The provenance record: environment identity resolved here (git SHA,
+    jax/jaxlib, ``XLA_FLAGS``) + the caller's program identity (mesh spec or
+    axis dict, compute dtype, chain length, global batch). Pure host-side
+    reads — never initializes the jax backend."""
+    import jax
+    import jaxlib
+
+    return {
+        "git_sha": _git_sha(),
+        "jax": str(jax.__version__),
+        "jaxlib": str(getattr(jaxlib, "__version__", "unknown")),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "mesh": mesh if mesh is None or isinstance(mesh, (str, dict)) else str(mesh),
+        "dtype": dtype,
+        "chain_steps": chain_steps,
+        "batch": batch,
+    }
+
+
+def differing_keys(a: "dict | None", b: "dict | None") -> list[str]:
+    """The configuration keys on which two provenance records disagree —
+    empty = comparable. A key absent (or None) on either side never
+    disagrees: old entries must not be un-comparable just because they
+    predate a field."""
+    if not a or not b:
+        return []
+    out = []
+    for key in COMPARE_KEYS:
+        va, vb = a.get(key), b.get(key)
+        if va is None or vb is None:
+            continue
+        if va != vb:
+            out.append(key)
+    return out

@@ -663,6 +663,20 @@ class Trainer:
                 # anchors its goodput-span chain here, so the spans cover
                 # exactly THIS attempt's wall.
                 fields["goodput_seconds"] = self.goodput.to_state()
+            # Provenance stamp (ISSUE 14): git SHA + jax/jaxlib + effective
+            # XLA_FLAGS + the program identity, so run_compare can refuse
+            # to diff runs that measured different programs. Inside the
+            # events.enabled guard like the rest of the field build.
+            from distributed_training_pytorch_tpu.telemetry.provenance import (
+                provenance_fields,
+            )
+
+            fields["provenance"] = provenance_fields(
+                mesh=fields["mesh"],
+                dtype=fields["compute_dtype"],
+                chain_steps=self.chain_steps,
+                batch=self.batch_size,
+            )
             self.events.emit("run_start", **fields)
         try:
             self._train_loop()

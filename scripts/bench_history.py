@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Bench-history ledger CLI (ISSUE 14) — read the committed rounds.
+
+Ingests the repo's committed ``BENCH_r*.json`` / ``MULTICHIP_r*.json``
+rounds into per-metric trajectories (``telemetry.history``) and prints the
+ledger with flat-streak and regression detections — the across-rounds
+instrument the per-run stack (goodput, StepProfile, doctor) never had:
+BENCH r02→r05 sat flat for four rounds and nothing noticed.
+
+Usage::
+
+    python scripts/bench_history.py                 # ledger + detections
+    python scripts/bench_history.py --json          # machine-readable
+    python scripts/bench_history.py --events E      # + a `bench_history`
+                                                    #   JSONL record
+    python scripts/bench_history.py --self-test     # CI gate (verify.sh)
+
+``--self-test`` asserts the detector's acceptance case on the committed
+files themselves: the r02→r05 plateau (step_ms ~76 ms, value ~54k
+img/s/chip, spread 1.4%) MUST be reported as a >= 4-round flat streak on
+both the ``step_ms`` and ``value`` series. If a future round breaks the
+plateau (the ROADMAP item 2 goal), re-anchor the self-test to a synthetic
+fixture — the detector boundary cases stay covered in
+``tests/test_run_compare.py`` either way.
+
+Exit codes: 0 ok, 1 self-test failure (expected streak not detected),
+2 no round files found under ``--root``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_training_pytorch_tpu.telemetry import history as history_lib  # noqa: E402
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def self_test(report) -> int:
+    """The committed-rounds acceptance check: r02->r05 must read as flat."""
+    failures = []
+    for field in ("step_ms", "value"):
+        hits = [
+            s for s in report.streaks
+            if s.series.endswith(f":: {field}")
+            and len(s.rounds) >= 4
+            and s.rounds[0] <= 2
+            and s.rounds[-1] >= 5
+        ]
+        if hits:
+            print(f"bench_history self-test [{field}]: {hits[0].describe()} — ok")
+        else:
+            failures.append(
+                f"{field}: no >=4-round flat streak covering r02->r05 "
+                f"(streaks: {[s.describe() for s in report.streaks]})"
+            )
+    if failures:
+        print("BENCH HISTORY SELF-TEST FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("bench_history self-test OK: the committed r02->r05 plateau is "
+          "detected on both the step_ms and value trajectories")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="directory holding the BENCH_r*/MULTICHIP_r* files "
+                             "(default: the repo root)")
+    parser.add_argument("--flat-tol", type=float, default=history_lib.FLAT_REL_TOL,
+                        help="flat-streak relative band (default %(default)s)")
+    parser.add_argument("--flat-rounds", type=int, default=history_lib.FLAT_MIN_ROUNDS,
+                        help="rounds needed for a flat streak to fire "
+                             "(default %(default)s; one fewer stays quiet)")
+    parser.add_argument("--regression-tol", type=float,
+                        default=history_lib.REGRESSION_REL_TOL,
+                        help="round-over-round bad-direction tolerance "
+                             "(default %(default)s)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the full ledger as one JSON object")
+    parser.add_argument("--events", default=None,
+                        help="append a bench_history record to this JSONL event log")
+    parser.add_argument("--self-test", action="store_true",
+                        help="CI gate: the committed r02->r05 plateau must be "
+                             "detected (verify.sh)")
+    args = parser.parse_args()
+
+    report = history_lib.analyze_history(
+        args.root,
+        flat_tol=args.flat_tol,
+        flat_min_rounds=args.flat_rounds,
+        regression_tol=args.regression_tol,
+    )
+    if not report.entries:
+        print(f"bench_history: no BENCH_r*/MULTICHIP_r* round files under "
+              f"{args.root}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.describe())
+
+    if args.events:
+        from distributed_training_pytorch_tpu.telemetry import EventLog
+
+        EventLog(args.events, process_index=0).emit(
+            "bench_history",
+            root=os.path.abspath(args.root),
+            entries=len(report.entries),
+            series=len(report.series),
+            streaks=[s.to_dict() for s in report.streaks],
+            regressions=[r.to_dict() for r in report.regressions],
+        )
+    if args.self_test:
+        return self_test(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

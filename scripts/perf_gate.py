@@ -44,6 +44,13 @@ Self-test seam: ``--inject-slowdown F`` multiplies the measured step time by
 asserts the gate FAILS with an injected 3x regression, so the gate's teeth
 are themselves tested on every run.
 
+FAIL pre-diagnosis (ISSUE 14): quick mode traces one extra window after the
+timed pairs and attaches the StepProfile category fractions to the
+measurement; a ``--update``-recorded baseline carries them too, and a FAIL
+prints the per-category attribution of its own measured-vs-baseline step_ms
+delta — the SAME ``profiling.diff`` implementation ``scripts/run_compare.py``
+uses (test-enforced: this script defines no attribution of its own).
+
 Exit codes: 0 pass, 1 regression, 2 refused (``--update`` combined with
 ``--inject-slowdown`` — a poisoned baseline would mask real regressions),
 3 no baseline entry for this key (record one with ``--update``), 4 baseline
@@ -67,6 +74,7 @@ import optax
 
 from distributed_training_pytorch_tpu.ops import cross_entropy_loss
 from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+from distributed_training_pytorch_tpu.profiling import diff as diff_lib
 from distributed_training_pytorch_tpu.profiling import gate as gate_lib
 from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
 
@@ -160,7 +168,7 @@ def measure_quick() -> dict:
         run_window, lambda: jax.block_until_ready(calib(x0))
     )
 
-    return {
+    measurement = {
         "workload": "gatenet-conv16x16-b64-chain8",
         "platform": jax.devices()[0].platform,
         "steps": QUICK_STEPS,
@@ -168,6 +176,33 @@ def measure_quick() -> dict:
         "calib_ms": round(calib_s * 1e3, 4),
         "step_per_calib": round(ratio / QUICK_STEPS, 4),
     }
+    # Category capture (ISSUE 14): trace ONE extra window of the exact
+    # workload AFTER the timed pairs (the trace gates nothing it measures)
+    # and attach the StepProfile category fractions. A baseline recorded
+    # with --update then carries them, and a later FAIL arrives
+    # pre-diagnosed — the attribution of its own measured-vs-baseline
+    # step_ms delta, through the SAME profiling.diff implementation
+    # run_compare uses (test-enforced). Degrades to an unattributed
+    # measurement on any capture/analysis failure.
+    import shutil
+    import tempfile
+
+    from distributed_training_pytorch_tpu import profiling as profiling_lib
+
+    prof_dir = tempfile.mkdtemp(prefix="perf_gate_prof_")
+    try:
+        with profiling_lib.trace(prof_dir):
+            run_window()
+        prof = profiling_lib.analyze_trace(prof_dir, steps=QUICK_STEPS)
+        measurement["categories"] = {
+            k: round(v, 4) for k, v in prof.categories.items() if v
+        }
+    except (ValueError, FileNotFoundError, OSError, RuntimeError) as e:
+        print(f"perf_gate: category capture failed ({e}) — a FAIL against "
+              "this measurement will be unattributed", file=sys.stderr)
+    finally:
+        shutil.rmtree(prof_dir, ignore_errors=True)
+    return measurement
 
 
 def measure_data_wait(inject_delay_s: float | None = None) -> dict:
@@ -354,6 +389,29 @@ def main() -> int:
         print(f"perf_gate: BAD BASELINE — {e}")
         return 4
     print("perf_gate: " + result.describe())
+    attribution = None
+    if not result.passed:
+        # FAIL upgrade (ISSUE 14): pre-diagnose the regression — attribute
+        # the measured-vs-baseline step_ms delta per category through the
+        # ONE profiling.diff implementation run_compare uses.
+        attribution = diff_lib.attribute_entry_delta(
+            baseline["entries"].get(key, {}), measurement
+        )
+        if attribution:
+            print("perf_gate: FAIL attribution (step_ms delta by category): "
+                  + diff_lib.describe_rows(attribution))
+        elif args.quick:
+            print("perf_gate: FAIL unattributed — the baseline entry or this "
+                  "measurement lacks `categories`; re-record with --update so "
+                  "future failures arrive pre-diagnosed (docs/profiling.md)")
+        elif not args.data_wait:
+            # Full mode records no category capture (only measure_quick
+            # traces a window), so the --update ritual cannot attribute it —
+            # point at the bench-side instrument instead.
+            print("perf_gate: FAIL unattributed — full mode captures no "
+                  "categories; run `BENCH_PROFILE=1 python bench.py` "
+                  "before/after and `scripts/run_compare.py` for the "
+                  "attribution (docs/profiling.md)")
     if args.events:
         from distributed_training_pytorch_tpu.telemetry import EventLog
 
@@ -366,6 +424,9 @@ def main() -> int:
             ratio=result.ratio,
             tolerance=result.tolerance,
             passed=result.passed,
+            attribution=(
+                [r.to_dict() for r in attribution] if attribution else None
+            ),
         )
     return 0 if result.passed else 1
 

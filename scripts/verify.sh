@@ -98,12 +98,22 @@
 # clean twin's exported timeline must be valid trace-event JSON whose
 # goodput spans re-derive the meter's fractions within epsilon.
 #
-# Stage 13 is the ROADMAP.md tier-1 command verbatim.
+# Stage 13 is the run-comparison gate (ISSUE 14; docs/profiling.md
+# "before/after ritual"): run_compare.py --self-test — identical twin runs
+# must diff clean (no goodput bucket over the noise floor), and three
+# injected known-cause slowdowns (a synthetic 3x convolution, the loader
+# load_delay_s seam, the async committer commit_delay_s seam) must each be
+# attributed to the correct category/bucket with evidence refs — followed
+# by bench_history.py --self-test: the committed BENCH_r02->r05 plateau
+# (step_ms ~76 ms flat for four rounds) must be detected as a flat streak
+# on the committed files themselves.
+#
+# Stage 14 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/13: import health (pytest --collect-only) =="
+echo "== stage 1/14: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -112,7 +122,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/13: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/14: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -138,25 +148,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/13: chained-dispatch retrace guard =="
+echo "== stage 3/14: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/13: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/14: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/13: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/14: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/13: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/14: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -166,26 +176,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/13: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/14: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/13: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/14: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/13: elastic chaos soak (kill on N devices, resume on M) =="
+echo "== stage 9/14: elastic chaos soak (kill on N devices, resume on M) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
   echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
   echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
   exit 11
 fi
 
-echo "== stage 10/13: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 10/14: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -197,7 +207,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 11/13: data-wait gate (clean + injected-starvation self-test) =="
+echo "== stage 11/14: data-wait gate (clean + injected-starvation self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait; then
   echo "DATA-WAIT GATE FAILED — the input pipeline's steady-state data_wait"
   echo "fraction exceeds the PERF_BASELINE.json ceiling (ROADMAP item 5)"
@@ -211,7 +221,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait --inject-data-wait 
 fi
 echo "data-wait gate self-test OK: injected loader sleep correctly failed"
 
-echo "== stage 12/13: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
+echo "== stage 12/14: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   echo "RUN DOCTOR SELF-TEST FAILED — an injected bottleneck was misdiagnosed,"
   echo "the clean twin was not healthy, or the exported timeline broke the"
@@ -219,7 +229,20 @@ if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   exit 13
 fi
 
-echo "== stage 13/13: tier-1 test suite =="
+echo "== stage 13/14: run-comparison gate (twin-diff + injected attribution + bench history) =="
+if ! JAX_PLATFORMS=cpu python scripts/run_compare.py --self-test; then
+  echo "RUN COMPARE SELF-TEST FAILED — identical twins did not diff clean, or"
+  echo "an injected known-cause slowdown (3x conv / loader sleep / commit"
+  echo "delay) was attributed to the wrong category/bucket (docs/profiling.md)"
+  exit 14
+fi
+if ! JAX_PLATFORMS=cpu python scripts/bench_history.py --self-test; then
+  echo "BENCH HISTORY SELF-TEST FAILED — the committed r02->r05 flat streak"
+  echo "was not detected on the committed BENCH_r files (docs/profiling.md)"
+  exit 14
+fi
+
+echo "== stage 14/14: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

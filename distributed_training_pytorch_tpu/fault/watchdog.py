@@ -52,6 +52,7 @@ class StepWatchdog:
         poll_interval: float | None = None,
         max_fires: int = 1,
         escalation_factor: float = 5.0,
+        on_patrol: Optional[Callable[[float], None]] = None,
     ):
         if timeout <= 0:
             raise ValueError(f"timeout must be positive, got {timeout}")
@@ -61,6 +62,14 @@ class StepWatchdog:
             poll_interval if poll_interval is not None else min(1.0, self.timeout / 4)
         )
         self.max_fires = max_fires
+        # Patrol hook (ISSUE 15): called once per poll iteration on the
+        # watchdog thread with the seconds since the last pat — the
+        # trainer's liveness heartbeat rides it, so the event log keeps
+        # pulsing (with an honest "no progress for N s" figure) while the
+        # main thread is stuck inside a step that will not return. Runs
+        # outside the lock; exceptions are swallowed (the watchdog must
+        # never take the process down, and neither may its passenger).
+        self.on_patrol = on_patrol
         # After a fire, the NEXT window is timeout * escalation_factor: the
         # first fire's recovery (SIGTERM -> flag -> break -> save) needs the
         # in-flight step to finish; escalating only declares the thread
@@ -69,6 +78,12 @@ class StepWatchdog:
         self.fired = 0
         self._pats = 0
         self._last_pat = time.monotonic()
+        # True-progress clock for the patrol hook: pat() alone moves it.
+        # _last_pat is re-armed by the fire path (the escalation window
+        # must restart after a SIGTERM recovery attempt), so a heartbeat
+        # reading _last_pat would claim progress the moment the watchdog
+        # fired — reporting a still-hung run as freshly alive.
+        self._last_progress = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # pat()/elapsed run on the training thread, _run on the watchdog
@@ -82,7 +97,7 @@ class StepWatchdog:
     def start(self) -> "StepWatchdog":
         if self._thread is not None:
             return self
-        self._last_pat = time.monotonic()
+        self._last_pat = self._last_progress = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, name="step-watchdog", daemon=True
@@ -94,7 +109,7 @@ class StepWatchdog:
         """Mark progress (call once per completed step)."""
         with self._lock:
             self._pats += 1
-            self._last_pat = time.monotonic()
+            self._last_pat = self._last_progress = time.monotonic()
 
     def stop(self) -> None:
         self._stop.set()
@@ -107,10 +122,24 @@ class StepWatchdog:
         with self._lock:
             return time.monotonic() - self._last_pat
 
+    @property
+    def progress_elapsed(self) -> float:
+        """Seconds since the last pat() — the TRUE no-progress figure.
+        Unlike :attr:`elapsed`'s clock, this one is never re-armed by a
+        fire: after a SIGTERM recovery attempt the run is still hung, and
+        the liveness heartbeat must keep saying so."""
+        with self._lock:
+            return time.monotonic() - self._last_progress
+
     def _run(self) -> None:
         window = self.timeout
         pats_at_fire = -1
         while not self._stop.wait(self.poll_interval):
+            if self.on_patrol is not None:
+                try:
+                    self.on_patrol(self.progress_elapsed)
+                except Exception:
+                    pass  # liveness plumbing must never wedge the watchdog
             fire = False
             with self._lock:
                 if self.fired >= self.max_fires:

@@ -35,7 +35,19 @@ preemption events, loss-scale state) into one surface:
   (ISSUE 14);
 * :mod:`~.history`  — the committed ``BENCH_r*``/``MULTICHIP_r*`` rounds as
   per-metric trajectories with flat-streak + regression detection
-  (``scripts/bench_history.py``; the r02→r05 plateau is the self-test).
+  (``scripts/bench_history.py``; the r02→r05 plateau is the self-test);
+* :mod:`~.monitor`  — the live-operations layer (ISSUE 15): a streaming
+  doctor tailing events.jsonl through the shared
+  :class:`~.events.EventFollower`, re-deriving the doctor's verdicts
+  online plus the liveness kinds (``stale_heartbeat``/``dead`` from the
+  heartbeat contract), with debounced :class:`~.monitor.AlertConfig`
+  rules (``scripts/run_monitor.py``: live view, fleet table, CI exit
+  codes);
+* :mod:`~.exporter` — the in-process rank-0 HTTP status endpoint
+  (``Telemetry(export_port=...)``): ``/status`` JSON + ``/metrics``
+  Prometheus text served from atomically-swapped snapshots of the live
+  trainer counters — never blocks the hot loop, degrades to a warning
+  when the port is taken.
 
 Wire-up: ``Trainer(telemetry="on")`` (or a :class:`Telemetry` instance for
 knobs); entries honor ``TELEMETRY=1``; see ``docs/observability.md``.
@@ -52,7 +64,9 @@ from distributed_training_pytorch_tpu.telemetry.anomaly import (  # noqa: F401
 )
 from distributed_training_pytorch_tpu.telemetry.events import (  # noqa: F401
     SCHEMA_VERSION,
+    EventFollower,
     EventLog,
+    load_run_events,
     read_events,
 )
 from distributed_training_pytorch_tpu.telemetry.goodput import (  # noqa: F401
@@ -75,6 +89,7 @@ __all__ = [
     "AnomalyDetector",
     "AnomalyError",
     "BUCKETS",
+    "EventFollower",
     "EventLog",
     "GoodputMeter",
     "PEAK_FLOPS",
@@ -82,6 +97,7 @@ __all__ = [
     "STAT_KEYS",
     "Telemetry",
     "device_peak_flops",
+    "load_run_events",
     "mfu_value",
     "read_events",
     "resolve_telemetry",
@@ -126,6 +142,20 @@ class Telemetry:
       about to block on every chip anyway — zero extra device syncs), and
       fed to the anomaly detector's floor-baselined ``straggler`` check.
       Degrades to absent fields on single-chip hosts.
+    * ``heartbeat_every_s`` — the liveness pulse (ISSUE 15,
+      docs/observability.md "Live monitoring"): a cheap ``heartbeat``
+      record at the existing ``log_every`` syncs and — when the
+      ``step_timeout`` watchdog is armed — from its patrol thread between
+      syncs, debounced to this cadence so an external monitor can tell
+      *training / hung / dead* apart from file mtime + record content
+      alone. ``0`` disables heartbeats (the pre-ISSUE-15 record stream).
+    * ``export_port``    — rank-0 in-process HTTP status endpoint
+      (``telemetry.exporter``): ``/status`` JSON and ``/metrics``
+      Prometheus text from the live trainer counters. ``None`` (default)
+      serves nothing; a taken port degrades to a warning, and the run
+      stays bit-exact (params + trace_counts) with the exporter off
+      (test-enforced). ``0`` binds an ephemeral port (tests) —
+      ``trainer.exporter.port`` reads it back.
     """
 
     events_path: str | None = None
@@ -136,6 +166,8 @@ class Telemetry:
     anomaly: AnomalyDetector | str | None = "warn"
     memory: bool = True
     straggler: bool = True
+    heartbeat_every_s: float = 30.0
+    export_port: int | None = None
 
     def resolve_anomaly(self) -> AnomalyDetector | None:
         if self.anomaly is None:

@@ -27,6 +27,13 @@ verdict                signature
 ``healthy``            none of the above
 =====================  ====================================================
 
+Two further kinds — ``stale_heartbeat`` (the run emits but no execution
+unit completes) and ``dead`` (the log itself went silent) — belong to the
+same vocabulary but are produced only by the streaming monitor
+(``telemetry/monitor.py``, ISSUE 15), which alone holds a wall clock to
+compare the log's last pulse against; a complete log read post-hoc is
+finished, not dead.
+
 **Steady-state fractions.** Verdicts divide by the wall the run could
 actually control: ``total - compile - restart_rollback -
 checkpoint_async`` (one-time warmup, resume overhead, and overlapped
@@ -63,6 +70,7 @@ __all__ = [
     "extract_signals",
     "scalar_fields",
     "steady_fractions",
+    "update_signals",
 ]
 
 VERDICTS = (
@@ -71,6 +79,12 @@ VERDICTS = (
     "checkpoint_stall",
     "straggler",
     "comm_heavy",
+    # Liveness verdicts (ISSUE 15): produced by the streaming monitor
+    # (``telemetry/monitor.py``), which alone can compare the log's last
+    # pulse against a wall clock — a finished log read post-hoc is neither
+    # stale nor dead. Named here so the vocabulary has ONE home.
+    "stale_heartbeat",
+    "dead",
     "healthy",
 )
 
@@ -163,44 +177,63 @@ class Diagnosis:
         return "\n".join(lines)
 
 
+def update_signals(sig: Signals, rec: dict) -> None:
+    """Fold ONE event record into :class:`Signals` — the incremental unit
+    behind both read paths (ISSUE 15): :func:`extract_signals` loops it
+    over a complete log (``scripts/run_doctor.py``), and the streaming
+    monitor (``telemetry/monitor.py``) calls it per record as its tail
+    follower yields them — so the post-hoc doctor and the live monitor
+    derive their verdicts from literally the same accumulation (the
+    same-log => byte-identical-verdicts regression test pins it)."""
+    kind = rec.get("event")
+    line = rec.get("_line")
+    if isinstance(rec.get("goodput_seconds"), dict):
+        # Cumulative counters: the LAST snapshot wins (append-across-
+        # restarts keeps them cumulative over the whole job). ONE evidence
+        # row, REPLACED rather than appended: heartbeats carry a snapshot
+        # every pulse (ISSUE 15), and an append here would grow every
+        # fraction verdict's evidence — and a long-lived monitor's memory
+        # — by one identical row per heartbeat.
+        sig.goodput_seconds = dict(rec["goodput_seconds"])
+        sig.evidence["goodput"] = [
+            dict(metric="goodput_seconds", line=line, timeline="goodput")
+        ]
+    if kind == "anomaly":
+        akind = str(rec.get("kind"))
+        sig.anomaly_counts[akind] = sig.anomaly_counts.get(akind, 0) + 1
+        if akind in ("straggler", "step_time_regression"):
+            sig.note("straggler", metric=f"anomaly:{akind}",
+                     value=rec.get("value"), line=line, timeline="markers")
+    elif kind == "hung_step":
+        sig.hung_steps += 1
+        sig.note("straggler", metric="hung_step",
+                 value=rec.get("timeout_s"), line=line, timeline="markers")
+    elif kind == "window" and rec.get("straggler_ratio") is not None:
+        r = float(rec["straggler_ratio"])
+        if sig.max_straggler_ratio is None or r > sig.max_straggler_ratio:
+            sig.max_straggler_ratio = r
+            sig.note("straggler_ratio", metric="straggler_ratio", value=round(r, 4),
+                     line=line, timeline="steps")
+    elif kind == "compile" and rec.get("kind") != "mfu_probe":
+        if int(rec.get("epoch", 0) or 0) >= 1:
+            sig.late_compiles += 1
+            sig.note("compile_bound", metric="late_compile",
+                     value=rec.get("executables"), line=line, timeline="markers")
+    elif kind == "profile_capture" and isinstance(rec.get("categories"), dict):
+        sig.comm_frac = float(rec["categories"].get("collective", 0.0))
+        sig.note("comm_heavy", metric="collective_frac",
+                 value=round(sig.comm_frac, 4), line=line, timeline="profile")
+
+
 def extract_signals(events: list[dict]) -> Signals:
-    """Distill an event log (``timeline.load_run_events`` output — records
+    """Distill an event log (``events.load_run_events`` output — records
     carry ``_line``) into :class:`Signals`, citing line numbers and the
-    timeline track each piece of evidence lands on."""
+    timeline track each piece of evidence lands on. A loop over
+    :func:`update_signals` and nothing more — the streaming monitor's
+    incremental path IS this path."""
     sig = Signals()
     for rec in events:
-        kind = rec.get("event")
-        line = rec.get("_line")
-        if isinstance(rec.get("goodput_seconds"), dict):
-            # Cumulative counters: the LAST snapshot wins (append-across-
-            # restarts keeps them cumulative over the whole job).
-            sig.goodput_seconds = dict(rec["goodput_seconds"])
-            sig.note("goodput", metric="goodput_seconds", line=line, timeline="goodput")
-        if kind == "anomaly":
-            akind = str(rec.get("kind"))
-            sig.anomaly_counts[akind] = sig.anomaly_counts.get(akind, 0) + 1
-            if akind in ("straggler", "step_time_regression"):
-                sig.note("straggler", metric=f"anomaly:{akind}",
-                         value=rec.get("value"), line=line, timeline="markers")
-        elif kind == "hung_step":
-            sig.hung_steps += 1
-            sig.note("straggler", metric="hung_step",
-                     value=rec.get("timeout_s"), line=line, timeline="markers")
-        elif kind == "window" and rec.get("straggler_ratio") is not None:
-            r = float(rec["straggler_ratio"])
-            if sig.max_straggler_ratio is None or r > sig.max_straggler_ratio:
-                sig.max_straggler_ratio = r
-                sig.note("straggler_ratio", metric="straggler_ratio", value=round(r, 4),
-                         line=line, timeline="steps")
-        elif kind == "compile" and rec.get("kind") != "mfu_probe":
-            if int(rec.get("epoch", 0) or 0) >= 1:
-                sig.late_compiles += 1
-                sig.note("compile_bound", metric="late_compile",
-                         value=rec.get("executables"), line=line, timeline="markers")
-        elif kind == "profile_capture" and isinstance(rec.get("categories"), dict):
-            sig.comm_frac = float(rec["categories"].get("collective", 0.0))
-            sig.note("comm_heavy", metric="collective_frac",
-                     value=round(sig.comm_frac, 4), line=line, timeline="profile")
+        update_signals(sig, rec)
     return sig
 
 

@@ -36,11 +36,23 @@ includes ``straggler``, the slowest-chip-ratio detector of
 delta, ranked attribution rows and provenance-mismatch keys, emitted by
 ``scripts/run_compare.py --events``; ``bench_history`` — the
 committed-rounds ledger's flat streaks and regressions, emitted by
-``scripts/bench_history.py --events``) — as one JSON object per line,
-machine-readable and append-only. Since schema 2 every record also
-carries ``chips`` (this process's local device ids) and ``schema``
-(:data:`SCHEMA_VERSION`), so per-chip attribution survives elastic
-topology changes and consumers can detect vocabularies they predate.
+``scripts/bench_history.py --events``), and the live-operations layer's
+records (ISSUE 15: ``heartbeat`` — the liveness pulse, emitted by the
+trainer at the existing ``log_every`` syncs (``source="loop"``: epoch,
+``step_in_epoch``, ``units`` executed this attempt, ``step_ms``,
+``live_bytes`` where sampled, and the cumulative ``goodput_seconds``
+snapshot) and from the step watchdog's patrol thread between syncs
+(``source="watchdog"``, plus ``since_progress_s`` — seconds since the
+last completed execution unit), debounced to
+``Telemetry(heartbeat_every_s=...)``; ``monitor_alert`` — a debounced
+alert-rule firing from the streaming monitor (``telemetry/monitor.py``:
+``rule``, ``run_dir``, ``status``, measured ``value`` vs ``threshold``,
+``message``, emitted by ``scripts/run_monitor.py --events``) — as one
+JSON object per line, machine-readable and append-only. Since schema 2
+every record also carries ``chips`` (this process's local device ids)
+and ``schema`` (:data:`SCHEMA_VERSION`), so per-chip attribution
+survives elastic topology changes and consumers can detect vocabularies
+they predate.
 
 Conventions:
 
@@ -74,15 +86,27 @@ from typing import Any, Iterator
 
 import jax
 
-__all__ = ["EventLog", "SCHEMA_VERSION", "read_events"]
+__all__ = [
+    "EventFollower",
+    "EventLog",
+    "SCHEMA_VERSION",
+    "load_run_events",
+    "read_events",
+    "resolve_events_path",
+]
 
 # Record-schema version, stamped on every record as ``schema`` so offline
 # consumers (the timeline exporter, the run doctor, dashboards) can detect
 # a vocabulary they predate instead of misparsing it. History:
 #   1 — implicit (PR 4-12 records carry no ``schema`` field);
 #   2 — this field + ``chips`` identity + straggler/goodput-snapshot
-#       window/epoch fields (ISSUE 13).
-SCHEMA_VERSION = 2
+#       window/epoch fields (ISSUE 13);
+#   3 — the live-operations vocabulary (ISSUE 15): ``heartbeat``
+#       (``source`` loop|watchdog, ``units``, ``since_progress_s``,
+#       ``goodput_seconds`` snapshot — the liveness pulse) and
+#       ``monitor_alert`` (``rule``, ``status``, ``value``/``threshold``
+#       — a debounced monitor rule firing).
+SCHEMA_VERSION = 3
 
 
 def _jsonable(value: Any) -> Any:
@@ -210,6 +234,161 @@ class EventLog:
         self.close()
 
 
+def _parse_tolerant(raw: bytes | str, lineno: int, path: str) -> dict | None:
+    """Parse ONE event-log line the tolerant way (the post-crash-audit
+    contract of ``read_events(strict=False)``): blank lines skip silently,
+    malformed JSON (a torn fragment from a hard kill, a corrupted write)
+    skips with a warning naming the file line, and only dict records
+    survive (a bare JSON scalar cannot carry an ``event`` field and would
+    crash every consumer downstream)."""
+    if isinstance(raw, bytes):
+        try:
+            raw = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            import warnings
+
+            warnings.warn(f"{path}:{lineno}: skipping undecodable event line: {e}")
+            return None
+    line = raw.strip()
+    if not line:
+        return None
+    try:
+        record = json.loads(line)
+    except json.JSONDecodeError as e:
+        import warnings
+
+        warnings.warn(f"{path}:{lineno}: skipping malformed event line: {e}")
+        return None
+    if not isinstance(record, dict):
+        import warnings
+
+        warnings.warn(
+            f"{path}:{lineno}: skipping non-object event line ({type(record).__name__})"
+        )
+        return None
+    return record
+
+
+def resolve_events_path(run_dir: str) -> str:
+    """Map a run directory (the Trainer ``save_folder``) to its event-log
+    path — or pass a direct ``.jsonl``/existing-file path through. The
+    ONE layout rule (``<save_folder>/telemetry/events.jsonl``) shared by
+    the timeline exporter, the run doctor, and the live monitor.
+
+    Resolution is by suffix/file-ness rather than ``isdir``: a monitor is
+    deliberately allowed to attach BEFORE the run creates its directory
+    (the EventFollower yields ``[]`` until the first emit), and an
+    isdir-based rule would freeze a not-yet-existing run dir into a
+    direct-file path that never resolves."""
+    if run_dir.endswith(".jsonl") or os.path.isfile(run_dir):
+        return run_dir
+    return os.path.join(run_dir, "telemetry", "events.jsonl")
+
+
+class EventFollower:
+    """Incremental, torn-line-tolerant reader over one events.jsonl file —
+    THE shared parser behind :func:`load_run_events` (the one-shot
+    consumers: timeline exporter, run doctor) and the live monitor's tail
+    (``telemetry/monitor.py``), so the two cannot drift (ISSUE 15).
+
+    Each :meth:`poll` returns the records whose lines became COMPLETE
+    (newline-terminated) since the last poll, each stamped with ``_line``
+    (the 1-based FILE line — blank and malformed lines still advance it,
+    so citations stay stable past the lines the tolerant parse skipped).
+    A trailing fragment with no newline is *withheld*, not rejected: a
+    live writer may still be mid-``write`` on it, and the next poll picks
+    it up once the newline lands. ``poll(final=True)`` — for post-mortem
+    reads, where no more bytes are coming — additionally parses the
+    unterminated tail (a complete record whose writer died before the
+    newline is data; a torn fragment warns and skips, exactly like
+    ``read_events(strict=False)``).
+
+    A file that does not exist yet yields ``[]`` (the monitor may attach
+    before the run's first emit); a file that SHRANK (a fresh attempt
+    truncating, a rotation) resets the cursor and re-reads from the top —
+    stale offsets must never silently hide a restarted run's records.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0  # bytes consumed through the last complete line
+        self._lineno = 0  # 1-based count of completed lines seen
+        self._partial = b""  # unterminated tail carried between polls
+        self._tail_emitted: bytes | None = None  # tail a final poll yielded
+        # Bumped on every truncation reset, so a stateful consumer (the
+        # monitor's Signals fold) knows its accumulated state describes a
+        # file that no longer exists and must be rebuilt.
+        self.generation = 0
+
+    def poll(self, *, final: bool = False) -> list[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []  # not written yet (or vanished): nothing to report
+        if size < self._offset:
+            # Truncated/rotated underneath us: start over from the top.
+            self._offset = 0
+            self._lineno = 0
+            self._partial = b""
+            self._tail_emitted = None
+            self.generation += 1
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return []
+        self._offset += len(data)
+        chunks = (self._partial + data).split(b"\n")
+        self._partial = chunks.pop()  # b"" when the data ended on a newline
+        records = []
+        for raw in chunks:
+            self._lineno += 1
+            if self._tail_emitted is not None:
+                # A prior final poll already yielded this exact tail; its
+                # newline landing now must not re-yield it (a monitor that
+                # declared a stalled writer dead, then saw it resurrect).
+                already, self._tail_emitted = raw == self._tail_emitted, None
+                if already:
+                    continue
+            rec = _parse_tolerant(raw, self._lineno, self.path)
+            if rec is not None:
+                rec["_line"] = self._lineno
+                records.append(rec)
+        if final and self._partial.strip() and self._partial != self._tail_emitted:
+            # Parse the unterminated tail WITHOUT consuming it: offset,
+            # line counter, and buffer stay put, so a writer that was only
+            # stalled (not dead) and later completes the line is read
+            # normally — no lost record, no drifted _line citations. A
+            # complete record missing only its newline is remembered in
+            # _tail_emitted so the newline's eventual arrival dedupes.
+            rec = _parse_tolerant(self._partial, self._lineno + 1, self.path)
+            if rec is not None:
+                rec["_line"] = self._lineno + 1
+                records.append(rec)
+                self._tail_emitted = self._partial
+        return records
+
+
+def load_run_events(run_dir: str) -> list[dict]:
+    """Read a run directory's (or a direct ``.jsonl`` path's) event log,
+    tolerant of a torn last line (post-crash audits are a primary
+    consumer). Each record gains a ``_line`` field — the 1-based position
+    in the file — so doctor evidence and timeline args can cite it.
+
+    One shot through the SAME :class:`EventFollower` the live monitor
+    tails with (``final=True``: the unterminated tail of a killed writer
+    is parsed rather than withheld) — the batch load IS the follower run
+    to completion, so the two read paths cannot drift."""
+    path = resolve_events_path(run_dir)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no event log at {path} — was the run telemetry-off? "
+            "(Trainer(telemetry='on') writes <save_folder>/telemetry/events.jsonl)"
+        )
+    return EventFollower(path).poll(final=True)
+
+
 def read_events(
     path: str, *, strict: bool = True, with_lineno: bool = False
 ) -> Iterator[dict]:
@@ -229,14 +408,16 @@ def read_events(
             line = line.strip()
             if not line:
                 continue
+            if not strict:
+                # The ONE tolerant parse (shared with EventFollower).
+                record = _parse_tolerant(line, lineno, path)
+                if record is not None:
+                    yield (lineno, record) if with_lineno else record
+                continue
             try:
                 record = json.loads(line)
                 yield (lineno, record) if with_lineno else record
             except json.JSONDecodeError as e:
-                if strict:
-                    raise ValueError(
-                        f"{path}:{lineno}: malformed event line: {e}"
-                    ) from e
-                import warnings
-
-                warnings.warn(f"{path}:{lineno}: skipping malformed event line: {e}")
+                raise ValueError(
+                    f"{path}:{lineno}: malformed event line: {e}"
+                ) from e

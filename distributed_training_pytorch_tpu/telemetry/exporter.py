@@ -1,0 +1,237 @@
+"""In-process status exporter: ``/status`` JSON + ``/metrics`` Prometheus
+text straight from the live trainer counters (ISSUE 15 tentpole c).
+
+``Trainer(telemetry=Telemetry(export_port=...))`` starts one
+:class:`StatusExporter` on process 0: a stdlib ``ThreadingHTTPServer`` on
+a daemon thread serving two endpoints —
+
+* ``GET /status``  — one JSON object: the trainer's latest status
+  snapshot (goodput fractions, step_ms, MFU, live/peak bytes, loss scale,
+  anomaly counts, the live doctor scores + top verdict);
+* ``GET /metrics`` — the same snapshot rendered as Prometheus exposition
+  text (gauges under the ``tpu_trainer_`` prefix), so a standard scrape
+  config points at a training job with zero glue.
+
+Design rules (the EventLog never-kills-training policy, applied to HTTP):
+
+* **The hot loop is never blocked.** The trainer *builds* a fresh
+  snapshot dict at its existing ``log_every`` sync points and swaps it in
+  with one (GIL-atomic) reference assignment; the HTTP threads only ever
+  read whichever complete dict the reference points at. No lock spans the
+  step loop, no handler touches live mutable trainer state, and a scrape
+  between syncs simply serves the previous snapshot.
+* **A taken port degrades to a warning.** Binding failure (another run on
+  the port, a permission error) logs one warning and disables the
+  exporter — a observability knob must never be why training died.
+* **Bit-exact with the exporter off.** The exporter reads host-side
+  floats the telemetry layer already fetched: params and
+  ``trace_counts`` are identical with ``export_port=None``
+  (test-enforced — the historical-program pillar).
+
+``port=0`` binds an ephemeral port (tests); read it back from
+:attr:`StatusExporter.port`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+# The ONE JSON-safety rule (events.py): non-finite floats become their repr
+# strings instead of bare NaN/Infinity tokens — a diverged run's /status
+# (loss=NaN is exactly when an operator scrapes it) must stay parseable by
+# strict JSON consumers, the same contract the event log keeps.
+from distributed_training_pytorch_tpu.telemetry.events import _jsonable
+
+__all__ = ["StatusExporter", "prometheus_text"]
+
+# Prometheus metric-name charset ([a-zA-Z_:][a-zA-Z0-9_:]*); label names
+# drop the colon. Everything else maps to "_".
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+# Label name per known dict-valued snapshot field (unknown dicts fall back
+# to the generic "key" label rather than being dropped).
+_DICT_LABELS = {
+    "goodput_seconds": "bucket",
+    "goodput_fractions": "bucket",
+    "steady_fractions": "bucket",
+    "anomaly_counts": "kind",
+    "doctor_scores": "verdict",
+}
+
+
+def _metric_name(prefix: str, key: str) -> str:
+    return f"{prefix}_{_NAME_OK.sub('_', str(key))}"
+
+
+def _fmt(value) -> str:
+    # Prometheus floats: repr round-trips exactly; bools become 0/1.
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    return repr(float(value))
+
+
+def _escape(value: str) -> str:
+    # Prometheus label-value escaping: backslash first, then quotes.
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prometheus_text(snapshot: dict, *, prefix: str = "tpu_trainer") -> str:
+    """Render a status snapshot as Prometheus exposition text (v0.0.4).
+
+    Numeric scalars become ``<prefix>_<key>`` gauges; dicts of numerics
+    become one labeled gauge per entry (label name from
+    ``_DICT_LABELS``); string fields collapse into ONE ``<prefix>_info``
+    gauge carrying them as labels (the node-exporter convention — a
+    verdict is a label, not a float). Non-numeric leaves are skipped:
+    the exporter must serve whatever the snapshot holds, never 500 on a
+    field it does not know."""
+    lines: list[str] = []
+    info_labels: list[tuple[str, str]] = []
+    for key in sorted(snapshot):
+        value = snapshot[key]
+        if isinstance(value, str):
+            info_labels.append((_LABEL_OK.sub("_", key), value))
+            continue
+        if isinstance(value, bool) or isinstance(value, (int, float)):
+            name = _metric_name(prefix, key)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(value)}")
+            continue
+        if isinstance(value, dict):
+            label = _DICT_LABELS.get(key, "key")
+            name = _metric_name(prefix, key)
+            samples = []
+            for k in sorted(value, key=str):
+                v = value[k]
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    samples.append(f'{name}{{{label}="{_escape(k)}"}} {_fmt(v)}')
+            if samples:
+                lines.append(f"# TYPE {name} gauge")
+                lines.extend(samples)
+    if info_labels:
+        name = f"{prefix}_info"
+        rendered = ",".join(f'{k}="{_escape(v)}"' for k, v in info_labels)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{{{rendered}}} 1")
+    up = f"{prefix}_up"
+    lines.append(f"# TYPE {up} gauge")
+    lines.append(f"{up} 1")
+    return "\n".join(lines) + "\n"
+
+
+class StatusExporter:
+    """Serve ``snapshot_fn()`` over HTTP from a daemon thread.
+
+    ``snapshot_fn`` is called on the HTTP thread per request and must be
+    cheap and read-only (the trainer passes a closure returning its
+    latest atomically-swapped snapshot dict). Any exception it raises is
+    answered as a 500 — never propagated into the server loop.
+    """
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], dict],
+        port: int,
+        *,
+        host: str = "0.0.0.0",
+        prefix: str = "tpu_trainer",
+        log=None,
+    ):
+        self.enabled = False
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port = None
+
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # One training job must never die (or spam its console) for a
+            # scraper's sake.
+            def log_message(self, *args):  # noqa: D102 — silence stdlib logging
+                pass
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+                route = self.path.split("?", 1)[0].rstrip("/") or "/status"
+                try:
+                    snapshot = snapshot_fn() or {}
+                except Exception as e:  # noqa: BLE001 — a snapshot bug is a 500, not a crash
+                    self._respond(500, "text/plain", f"snapshot failed: {e}\n")
+                    return
+                if route in ("/status", "/"):
+                    self._respond(
+                        200, "application/json",
+                        json.dumps(_jsonable(snapshot)) + "\n",
+                    )
+                elif route == "/metrics":
+                    self._respond(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        prometheus_text(snapshot, prefix=exporter._prefix),
+                    )
+                else:
+                    self._respond(404, "text/plain", "try /status or /metrics\n")
+
+            def _respond(self, code: int, ctype: str, body: str):
+                try:
+                    payload = body.encode("utf-8")
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except OSError:
+                    pass  # client went away mid-response: its problem
+
+        self._prefix = prefix
+        warn = log if log is not None else _default_warn
+        try:
+            self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        except OSError as e:
+            # The EventLog policy: a taken port (another run already
+            # exporting there, a privileged port) is a warning, not a
+            # reason training dies.
+            warn(
+                f"status exporter disabled — could not bind {host}:{port} ({e}); "
+                "training continues without /status"
+            )
+            return
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="status-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        self.enabled = True
+
+    def close(self) -> None:
+        """Stop serving and release the port (idempotent)."""
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except OSError:
+                pass
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.enabled = False
+
+    def __enter__(self) -> "StatusExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _default_warn(msg: str) -> None:
+    import warnings
+
+    warnings.warn(msg)

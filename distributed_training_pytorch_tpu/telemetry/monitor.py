@@ -1,0 +1,501 @@
+"""Streaming run monitor: the run doctor, live (ISSUE 15).
+
+Everything observability built so far — events, goodput, timeline, doctor,
+run comparison — runs *after* (or outside) the training process: an
+operator cannot tell a healthy slow run from a wedged or SIGKILL'd one
+without ssh-ing in, and no anomaly reaches anyone until someone runs
+``run_doctor.py`` by hand. This module tails a run's events.jsonl while
+the run is alive and maintains the doctor's diagnosis *online*:
+
+* **One reader, one verdict engine.** Records stream in through the same
+  :class:`~.events.EventFollower` the one-shot ``load_run_events`` wraps,
+  and fold into :class:`~.doctor.Signals` through the same
+  :func:`~.doctor.update_signals` the post-hoc doctor loops — the monitor
+  cannot disagree with ``run_doctor.py`` about a log they both read
+  (regression-tested: same log => byte-identical verdicts).
+
+* **The liveness contract.** The trainer emits a cheap ``heartbeat``
+  record at every ``log_every`` sync (``source="loop"``) and — between
+  syncs — from the step watchdog's patrol thread (``source="watchdog"``,
+  carrying ``since_progress_s``). That makes *no signal itself a signal*:
+
+  ===================  ===================================================
+  status               rule
+  ===================  ===================================================
+  ``training``         fresh records, and an execution unit completed
+                       within ``stale_after_s``
+  ``stale_heartbeat``  records still arrive (the process breathes) but no
+                       unit has completed for ``stale_after_s`` — a hung
+                       collective, a wedged storage mount, a stuck loader
+  ``dead``             the log itself is silent past ``dead_after_s``
+                       (freshest of last record ``t_wall`` and file
+                       mtime): the process was SIGKILL'd, OOM-reaped, or
+                       lost its host
+  ``finished``         a ``run_end`` record closed the attempt — the
+                       post-hoc verdict applies, nothing is stale
+  ``waiting``          no event log (or no records) yet
+  ===================  ===================================================
+
+* **Alert rules** (:class:`AlertConfig`): the stale/dead ceilings above,
+  steady-state ``data_wait``/``checkpoint`` fraction ceilings (the
+  doctor's thresholds by default), anomaly kinds, and verdict transitions
+  (``compile_bound``/``straggler``/``comm_heavy`` crossing score 1.0).
+  Every rule is **debounced**: it fires once when its condition goes
+  false->true and re-arms only after the condition clears — a starved
+  pipeline that stays starved pages once, not once per poll. Firings
+  surface as ``monitor_alert`` JSONL records (``run_monitor.py
+  --events``) and as a non-zero exit for CI (``--once``).
+
+``scripts/run_monitor.py`` renders this as a live single-run view or a
+multi-run fleet table; ``verify.sh`` proves the contract with a real run
+driven through the existing fault seams (hang -> ``stale_heartbeat``,
+SIGKILL -> ``dead``, loader sleep -> exactly one ``data_bound`` alert).
+
+Clock caveat: liveness compares the writer's ``t_wall`` (and the log
+file's mtime) against *this* process's ``time.time()`` — cross-host
+monitoring inherits whatever clock skew the fleet tolerates. Keep the
+ceilings comfortably above NTP drift (the defaults are).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib
+from distributed_training_pytorch_tpu.telemetry.events import (
+    EventFollower,
+    resolve_events_path,
+)
+
+__all__ = ["AlertConfig", "MonitorStatus", "RunMonitor", "worst_exit_code"]
+
+# Record kinds whose arrival proves the MAIN thread completed (or is
+# completing) execution units — the "progress" half of the liveness
+# contract. Worker-thread records (checkpoint_commit, watchdog-source
+# heartbeats, hung_step) deliberately absent: a wedged main thread keeps
+# none of these from being written.
+_PROGRESS_KINDS = (
+    "run_start",
+    "checkpoint_restore",
+    "window",
+    "epoch_end",
+    "compile",
+    "checkpoint_save",
+    "preemption",
+    "run_end",
+)
+
+# Verdicts alerted on transition (score crossing 1.0). data_bound /
+# checkpoint_stall are NOT here — their fraction ceilings below are the
+# configurable alert surface, and double-reporting one disease through
+# two rules would page twice.
+_VERDICT_RULES = ("compile_bound", "straggler", "comm_heavy")
+
+
+@dataclasses.dataclass
+class AlertConfig:
+    """The monitor's rule thresholds (ISSUE 15 tentpole d).
+
+    * ``stale_after_s`` — no completed execution unit for this long (while
+      records still arrive) => ``stale_heartbeat``. Keep it above the
+      slowest honest window wall (and above epoch glue like validation).
+    * ``dead_after_s``   — the log silent for this long => ``dead``.
+      ``None`` = ``3 x stale_after_s``. Keep it above
+      ``Telemetry(heartbeat_every_s)`` with margin, or every network
+      hiccup reads as a death.
+    * ``data_wait_frac`` / ``checkpoint_frac`` — steady-state goodput
+      fraction ceilings (the doctor's thresholds by default, but an alert
+      ceiling may legitimately sit below a diagnosis ceiling).
+    * ``anomaly_kinds``  — anomaly record kinds that page (first
+      occurrence per kind).
+    * ``min_steady_s``   — fraction rules stay quiet until this much
+      steady-state wall is accounted: the first post-warmup sync's tiny
+      denominator must not page the fleet.
+    """
+
+    stale_after_s: float = 120.0
+    dead_after_s: float | None = None
+    data_wait_frac: float = doctor_lib.THRESHOLDS["data_wait_frac"]
+    checkpoint_frac: float = doctor_lib.THRESHOLDS["checkpoint_frac"]
+    anomaly_kinds: tuple = (
+        "loss_spike",
+        "grad_explosion",
+        "step_time_regression",
+        "memory_growth",
+        "straggler",
+    )
+    min_steady_s: float = 1.0
+
+    def resolved_dead_after(self) -> float:
+        return (
+            float(self.dead_after_s)
+            if self.dead_after_s is not None
+            else 3.0 * float(self.stale_after_s)
+        )
+
+
+@dataclasses.dataclass
+class MonitorStatus:
+    """One poll's answer: liveness + the doctor's online diagnosis."""
+
+    run_dir: str
+    status: str  # waiting | training | stale_heartbeat | dead | finished
+    verdict: str  # liveness kind when stale/dead, else the doctor's top verdict
+    diagnosis: "doctor_lib.Diagnosis | None"
+    steady_fractions: dict
+    last_event_age_s: float | None
+    progress_age_s: float | None
+    headline: dict  # epoch / step_in_epoch / units / step_ms from the last pulse
+    alerts: list  # rules that fired THIS poll (debounced)
+    active_alerts: tuple  # every rule currently over its line
+
+    @property
+    def exit_code(self) -> int:
+        """The ``--once`` CI contract: 0 = alive (or finished) and clean,
+        1 = degraded (stale heartbeat, a non-healthy verdict, or any alert
+        rule currently over its line), 2 = dead, 3 = nothing to monitor."""
+        if self.status == "dead":
+            return 2
+        if self.status == "waiting":
+            return 3
+        if (
+            self.status == "stale_heartbeat"
+            or self.verdict != "healthy"
+            or self.active_alerts
+        ):
+            return 1
+        return 0
+
+    def to_dict(self) -> dict:
+        out = {
+            "run_dir": self.run_dir,
+            "status": self.status,
+            "verdict": self.verdict,
+            "steady_fractions": self.steady_fractions,
+            "last_event_age_s": self.last_event_age_s,
+            "progress_age_s": self.progress_age_s,
+            "headline": self.headline,
+            "alerts": self.alerts,
+            "active_alerts": list(self.active_alerts),
+            "exit_code": self.exit_code,
+        }
+        if self.diagnosis is not None:
+            out["diagnosis"] = self.diagnosis.to_dict()
+        return out
+
+    def describe(self) -> str:
+        """The single-run console view (``scripts/run_monitor.py``)."""
+        ages = []
+        if self.last_event_age_s is not None:
+            ages.append(f"last event {self.last_event_age_s:.1f}s ago")
+        if self.progress_age_s is not None:
+            ages.append(f"progress {self.progress_age_s:.1f}s ago")
+        hl = ", ".join(
+            f"{k} {self.headline[k]}"
+            for k in ("epoch", "step_in_epoch", "units", "step_ms")
+            if self.headline.get(k) is not None
+        )
+        lines = [
+            f"{self.run_dir}: {self.status.upper()} [{self.verdict}]"
+            + (f" ({'; '.join(ages)})" if ages else ""),
+        ]
+        if hl:
+            lines.append(f"  {hl}")
+        fr = self.steady_fractions
+        if any(fr.values()):
+            lines.append(
+                "  steady: productive {:.0%} data_wait {:.0%} checkpoint {:.0%}".format(
+                    fr.get("productive_step", 0.0),
+                    fr.get("data_wait", 0.0),
+                    fr.get("checkpoint", 0.0),
+                )
+            )
+        if self.diagnosis is not None and self.status not in ("waiting",):
+            lines.append(self.diagnosis.describe())
+        for a in self.alerts:
+            lines.append(f"  ALERT [{a['rule']}]: {a.get('message', '')}")
+        return "\n".join(lines)
+
+    def fleet_row(self) -> dict:
+        """The multi-run table projection (stable key order)."""
+        fr = self.steady_fractions
+        age = self.last_event_age_s
+        return {
+            "run": os.path.basename(os.path.normpath(self.run_dir)) or self.run_dir,
+            "status": self.status,
+            "verdict": self.verdict,
+            "epoch": self.headline.get("epoch", "-"),
+            "step": self.headline.get("step_in_epoch", "-"),
+            "step_ms": (
+                f"{self.headline['step_ms']:.1f}"
+                if isinstance(self.headline.get("step_ms"), (int, float))
+                else "-"
+            ),
+            "good%": f"{100 * fr.get('productive_step', 0.0):.0f}",
+            "data%": f"{100 * fr.get('data_wait', 0.0):.0f}",
+            "ckpt%": f"{100 * fr.get('checkpoint', 0.0):.0f}",
+            "age_s": f"{age:.1f}" if age is not None else "-",
+            "alerts": ",".join(self.active_alerts) or "-",
+        }
+
+
+def worst_exit_code(statuses) -> int:
+    """Fleet aggregation for ``--once``: a real finding (dead=2 over
+    degraded=1) wins over everything; otherwise ``waiting`` (3 — nothing
+    to monitor, the likely misconfiguration) wins over clean (0)."""
+    codes = [s.exit_code for s in statuses]
+    real = [c for c in codes if c in (1, 2)]
+    if real:
+        return max(real)
+    return 3 if (3 in codes or not codes) else 0
+
+
+class RunMonitor:
+    """Incremental monitor over one run directory (see module doc).
+
+    ``alert_log`` is an :class:`~.events.EventLog` (or None) receiving one
+    ``monitor_alert`` record per debounced rule firing; ``clock`` is
+    injectable for tests (defaults to ``time.time`` — the same clock
+    domain as the records' ``t_wall``).
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        config: AlertConfig | None = None,
+        *,
+        alert_log=None,
+        clock=time.time,
+    ):
+        self.run_dir = str(run_dir)
+        self.path = resolve_events_path(self.run_dir)
+        self.config = config if config is not None else AlertConfig()
+        self._follower = EventFollower(self.path)
+        self.event_log = alert_log
+        self._clock = clock
+        self._generation = self._follower.generation
+        self._reset_state()
+
+    def _reset_state(self) -> None:
+        """Fresh accumulation state — the ctor, and again whenever the
+        follower detects the log was truncated/rotated underneath us: the
+        old Signals describe a file that no longer exists, and folding the
+        re-read records on top would double-count and weld two runs'
+        verdicts together. Alert debounce state resets too (a fresh run's
+        recurrence of a condition is a fresh page)."""
+        self.signals = doctor_lib.Signals()
+        self._seen_any = False
+        self._run_ended = False
+        self._drained_tail = False
+        self._last_wall: float | None = None  # newest record's t_wall
+        self._progress_wall: float | None = None  # when a unit last completed
+        self._active: dict[str, bool] = {}  # rule -> currently-over-the-line
+        self.headline: dict = {}
+
+    # -- ingestion ---------------------------------------------------------
+
+    def _ingest(self, rec: dict) -> None:
+        doctor_lib.update_signals(self.signals, rec)
+        self._seen_any = True
+        kind = rec.get("event")
+        t_wall = rec.get("t_wall")
+        t_wall = float(t_wall) if isinstance(t_wall, (int, float)) else None
+        if t_wall is not None and (self._last_wall is None or t_wall > self._last_wall):
+            self._last_wall = t_wall
+        if kind == "heartbeat":
+            for key in ("epoch", "step_in_epoch", "units", "step_ms"):
+                if rec.get(key) is not None:
+                    self.headline[key] = rec[key]
+            if t_wall is not None:
+                if rec.get("source") == "watchdog":
+                    # The patrol thread says how long ago the main thread
+                    # last completed a unit — progress is t_wall minus that
+                    # lag, NOT the record's own (worker-thread) timestamp.
+                    lag = float(rec.get("since_progress_s") or 0.0)
+                    prog = t_wall - lag
+                else:
+                    prog = t_wall
+                if self._progress_wall is None or prog > self._progress_wall:
+                    self._progress_wall = prog
+        elif kind in _PROGRESS_KINDS:
+            if kind == "run_start":
+                self._run_ended = False  # a resumed attempt re-opens the run
+            elif kind == "run_end":
+                self._run_ended = True
+            for key in ("epoch", "step_in_epoch"):
+                if rec.get(key) is not None:
+                    self.headline[key] = rec[key]
+            if rec.get("step_ms") is not None:
+                self.headline["step_ms"] = rec["step_ms"]
+            if t_wall is not None and (
+                self._progress_wall is None or t_wall > self._progress_wall
+            ):
+                self._progress_wall = t_wall
+
+    # -- liveness ----------------------------------------------------------
+
+    def _freshness(self) -> float | None:
+        """Newest of (last record t_wall, log-file mtime) — the mtime
+        covers the torn-write case where bytes landed but no complete
+        record has parsed yet."""
+        last = self._last_wall
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            mtime = None
+        if mtime is not None and (last is None or mtime > last):
+            last = mtime
+        return last
+
+    def _liveness(self, now: float) -> str:
+        if not self._seen_any:
+            return "waiting"
+        if self._run_ended:
+            return "finished"
+        fresh = self._freshness()
+        if fresh is None:
+            return "waiting"
+        if now - fresh >= self.config.resolved_dead_after():
+            return "dead"
+        progress = self._progress_wall if self._progress_wall is not None else fresh
+        if now - progress >= self.config.stale_after_s:
+            return "stale_heartbeat"
+        return "training"
+
+    # -- alert rules (debounced) -------------------------------------------
+
+    def _evaluate_alerts(self, status: str, diagnosis, fractions, now) -> list:
+        cfg = self.config
+        fired: list[dict] = []
+
+        def rule(key: str, firing: bool, value=None, threshold=None, message=""):
+            was = self._active.get(key, False)
+            self._active[key] = bool(firing)
+            if firing and not was:
+                fired.append(
+                    {
+                        "rule": key,
+                        "value": value,
+                        "threshold": threshold,
+                        "message": message,
+                    }
+                )
+
+        fresh = self._freshness()
+        age = None if fresh is None else now - fresh
+        prog_age = None if self._progress_wall is None else now - self._progress_wall
+        rule(
+            "dead",
+            status == "dead",
+            value=None if age is None else round(age, 1),
+            threshold=cfg.resolved_dead_after(),
+            message="event log silent — process killed or host lost",
+        )
+        rule(
+            "stale_heartbeat",
+            status == "stale_heartbeat",
+            value=None if prog_age is None else round(prog_age, 1),
+            threshold=cfg.stale_after_s,
+            message="heartbeats arrive but no execution unit completes — hung",
+        )
+        steady = sum(
+            float(v)
+            for b, v in (self.signals.goodput_seconds or {}).items()
+            if b not in doctor_lib._EXCLUDED
+        )
+        fractions_armed = steady >= cfg.min_steady_s
+        rule(
+            "data_bound",
+            fractions_armed and fractions.get("data_wait", 0.0) > cfg.data_wait_frac,
+            value=round(fractions.get("data_wait", 0.0), 4),
+            threshold=cfg.data_wait_frac,
+            message="steady-state data_wait fraction over the alert ceiling",
+        )
+        rule(
+            "checkpoint_stall",
+            fractions_armed and fractions.get("checkpoint", 0.0) > cfg.checkpoint_frac,
+            value=round(fractions.get("checkpoint", 0.0), 4),
+            threshold=cfg.checkpoint_frac,
+            message="steady-state checkpoint fraction over the alert ceiling",
+        )
+        for kind in cfg.anomaly_kinds:
+            n = int(self.signals.anomaly_counts.get(kind, 0))
+            rule(
+                f"anomaly:{kind}",
+                n > 0,
+                value=n,
+                threshold=1,
+                message=f"{n} {kind} anomaly record(s) in the log",
+            )
+        scores = {v.kind: v for v in (diagnosis.verdicts if diagnosis else [])}
+        for kind in _VERDICT_RULES:
+            v = scores.get(kind)
+            rule(
+                kind,
+                v is not None and v.score >= 1.0,
+                value=None if v is None else round(v.score, 3),
+                threshold=1.0,
+                message=v.summary if v is not None else "",
+            )
+
+        if fired and self.event_log is not None:
+            for a in fired:
+                self.event_log.emit(
+                    "monitor_alert",
+                    run_dir=self.run_dir,
+                    status=status,
+                    **a,
+                )
+        return fired
+
+    # -- the poll ----------------------------------------------------------
+
+    def poll(self) -> MonitorStatus:
+        """Consume newly completed records, re-derive liveness + diagnosis,
+        evaluate the (debounced) alert rules. Call on any cadence — each
+        poll costs one stat + one incremental read."""
+        now = self._clock()
+        recs = self._follower.poll()
+        if self._follower.generation != self._generation:
+            # The log shrank underneath us (fresh attempt, rotation): the
+            # follower re-read from the top and `recs` IS the new file —
+            # drop the old file's accumulated state before folding it.
+            self._generation = self._follower.generation
+            self._reset_state()
+        for rec in recs:
+            self._ingest(rec)
+        status = self._liveness(now)
+        if status in ("dead", "finished") and not self._drained_tail:
+            # No more bytes are coming: a killed writer's torn tail (or a
+            # final complete line missing its newline) is data now.
+            self._drained_tail = True
+            for rec in self._follower.poll(final=True):
+                self._ingest(rec)
+        diagnosis = doctor_lib.diagnose(self.signals) if self._seen_any else None
+        fractions = doctor_lib.steady_fractions(self.signals.goodput_seconds or {})
+        if status in ("stale_heartbeat", "dead"):
+            verdict = status
+        elif diagnosis is not None:
+            verdict = diagnosis.verdict
+        else:
+            verdict = "healthy"
+        fresh = self._freshness()
+        alerts = self._evaluate_alerts(status, diagnosis, fractions, now)
+        return MonitorStatus(
+            run_dir=self.run_dir,
+            status=status,
+            verdict=verdict,
+            diagnosis=diagnosis,
+            steady_fractions=fractions,
+            last_event_age_s=None if fresh is None else max(0.0, now - fresh),
+            progress_age_s=(
+                None
+                if self._progress_wall is None
+                else max(0.0, now - self._progress_wall)
+            ),
+            headline=dict(self.headline),
+            alerts=alerts,
+            active_alerts=tuple(k for k, on in self._active.items() if on),
+        )

@@ -67,7 +67,9 @@ from __future__ import annotations
 import json
 import os
 
-from distributed_training_pytorch_tpu.telemetry.events import read_events
+from distributed_training_pytorch_tpu.telemetry.events import (
+    load_run_events,  # noqa: F401 — re-exported: the historical import site
+)
 from distributed_training_pytorch_tpu.telemetry.goodput import BUCKETS
 
 __all__ = [
@@ -99,24 +101,11 @@ TRACKS = {
 _COMMON_FIELDS = ("event", "t_wall", "t_mono", "process", "host", "pid", "chips", "schema")
 
 
-def load_run_events(run_dir: str) -> list[dict]:
-    """Read a run directory's (or a direct ``.jsonl`` path's) event log,
-    tolerant of a torn last line (post-crash audits are a primary
-    consumer). Each record gains a ``_line`` field — the 1-based position
-    in the file — so doctor evidence and timeline args can cite it."""
-    path = run_dir
-    if os.path.isdir(run_dir):
-        path = os.path.join(run_dir, "telemetry", "events.jsonl")
-    if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"no event log at {path} — was the run telemetry-off? "
-            "(Trainer(telemetry='on') writes <save_folder>/telemetry/events.jsonl)"
-        )
-    events = []
-    for lineno, rec in read_events(path, strict=False, with_lineno=True):
-        rec["_line"] = lineno  # the FILE line — stable past torn/blank lines
-        events.append(rec)
-    return events
+# load_run_events lives in ``telemetry/events.py`` since ISSUE 15 — ONE
+# shared torn-line-tolerant reader (``events.EventFollower``) behind the
+# timeline, the run doctor, and the live monitor, so the parsers cannot
+# drift. The name stays importable here (the historical import site;
+# test-enforced that this module owns no private parser).
 
 
 class _Track:
@@ -222,6 +211,13 @@ def build_timeline(events: list[dict]) -> dict:
                         )
                         goodput_cursor[pid] += dur
             last_goodput[pid] = dict(snap)
+
+        # -- heartbeats (ISSUE 15): liveness plumbing. Their goodput
+        # snapshot (handled above) refines the goodput span chain; an
+        # instant marker per pulse would bury the narrative lane under
+        # one dot every heartbeat_every_s.
+        if kind == "heartbeat":
+            continue
 
         # -- span-bearing kinds -------------------------------------------
         if kind == "window":

@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import threading
 import time
 from typing import Any, Mapping
 
@@ -366,6 +367,34 @@ class Trainer:
         self._hung_steps = 0
         self._late_compiles = 0
         self._peak_flops = 0.0  # finalized after mesh selection below
+        # Live-operations layer (ISSUE 15; docs/observability.md "Live
+        # monitoring"): the heartbeat pulse + the optional in-process
+        # status exporter. Heartbeats are emitted at the existing
+        # log_every syncs (source="loop") and, when the step_timeout
+        # watchdog is armed, from its patrol thread between syncs
+        # (source="watchdog" + since_progress_s) — both debounced to
+        # heartbeat_every_s through ONE lock-guarded gate (the patrol
+        # thread and the loop race the debounce state, nothing else).
+        self._heartbeat_every_s = (
+            float(getattr(self.telemetry, "heartbeat_every_s", 0.0) or 0.0)
+            if self.telemetry is not None
+            else 0.0
+        )
+        self._hb_lock = threading.Lock()
+        self._hb_last_emit = 0.0
+        # The last sync point's progress fields, swapped wholesale under
+        # the lock so a patrol-thread heartbeat reads one coherent dict
+        # (its step fields may lag the hang by up to log_every steps; its
+        # since_progress_s figure is exact — the watchdog measures it).
+        self._hb_fields: dict = {}
+        # Status exporter (telemetry/exporter.py): constructed in train()
+        # on process 0 when Telemetry(export_port=...) asks for it. The
+        # trainer BUILDS a fresh snapshot dict at its sync points and
+        # swaps the reference; the exporter's HTTP threads only read
+        # whichever complete dict the reference points at — the hot loop
+        # is never blocked and never shares mutable state with a scrape.
+        self.exporter = None
+        self._status: dict = {}
         # Recovery skips (restore_latest_valid / the resume peek walking past
         # a corrupt checkpoint) land in the event log as `checkpoint_rejected`
         # records.
@@ -678,6 +707,39 @@ class Trainer:
                 batch=self.batch_size,
             )
             self.events.emit("run_start", **fields)
+        # Status exporter (ISSUE 15): rank-0 only, constructed per train()
+        # attempt and torn down in the finally below. A taken port warns
+        # and disables (never a reason training dies); the run itself is
+        # bit-exact with export_port=None (the exporter only READS
+        # host-side snapshots — test-enforced).
+        if (
+            self.telemetry is not None
+            and self.telemetry.export_port is not None
+            and jax.process_index() == 0
+        ):
+            from distributed_training_pytorch_tpu.telemetry.exporter import (
+                StatusExporter,
+            )
+
+            self.exporter = StatusExporter(
+                lambda: self._status,
+                self.telemetry.export_port,
+                log=lambda msg: self.log(msg, "warning"),
+            )
+        # Seed the liveness pulse: a monitor attaching before the first
+        # log_every sync still sees a heartbeat (and the exporter serves a
+        # pre-first-sync snapshot instead of an empty dict). `units` on
+        # heartbeats counts executed units cumulatively across THIS
+        # attempt (epochs reset `executed`; a liveness progress marker
+        # must be monotone).
+        self._attempt_units = 0
+        self._note_heartbeat_progress(
+            epoch=self.cur_epoch,
+            step_in_epoch=self._resume_step_in_epoch,
+            units=0,
+        )
+        self._emit_heartbeat("loop")
+        self._update_status(step_in_epoch=self._resume_step_in_epoch, units=0)
         try:
             self._train_loop()
         finally:
@@ -711,6 +773,14 @@ class Trainer:
                 if self.anomaly_detector is not None:
                     fields["anomalies"] = self.anomaly_detector.total_fired
                 self.events.emit("run_end", **fields)
+            # Final exporter snapshot (phase "finished"), then release the
+            # port — a scraper that races the teardown gets either the
+            # terminal snapshot or a connection refusal, never a hang. A
+            # re-entered train() constructs a fresh exporter.
+            self._update_status(phase="finished")
+            if self.exporter is not None:
+                self.exporter.close()
+                self.exporter = None
             self.events.close()  # a re-entered train() lazily reopens (append)
             self.metrics_writer.close()
 
@@ -1145,6 +1215,89 @@ class Trainer:
             late_compiles=self._late_compiles,
         )
 
+    def _emit_heartbeat(self, source: str, **extra) -> None:
+        """The liveness pulse (ISSUE 15): one cheap ``heartbeat`` record,
+        debounced to ``heartbeat_every_s`` across BOTH sources (the
+        log_every sync and the watchdog patrol thread share one gate —
+        the contract is "the log pulses at least this often while the
+        process lives", not one pulse per source). Carries the last sync
+        point's progress fields plus the cumulative goodput snapshot;
+        zero device syncs (host counters and an allocator-free dict
+        build only)."""
+        if not self._heartbeat_every_s or not self.events.enabled:
+            return
+        now = time.monotonic()
+        with self._hb_lock:
+            if now - self._hb_last_emit < self._heartbeat_every_s:
+                return
+            self._hb_last_emit = now
+            fields = dict(self._hb_fields)
+        fields.update(extra)
+        if self.goodput is not None:
+            # GoodputMeter's bucket keys are fixed at construction, so a
+            # patrol-thread read races only float value updates — safe.
+            fields["goodput_seconds"] = self.goodput.to_state()
+        self.events.emit("heartbeat", source=source, **fields)
+
+    def _note_heartbeat_progress(self, **fields) -> None:
+        """Refresh the progress fields patrol-thread heartbeats report
+        (one dict swap under the heartbeat lock)."""
+        with self._hb_lock:
+            self._hb_fields = dict(fields)
+
+    def _heartbeat_patrol(self, since_progress_s: float) -> None:
+        """Watchdog patrol-thread hook (``StepWatchdog(on_patrol=...)``):
+        keep the event log pulsing while the main thread is stuck inside
+        a step — ``since_progress_s`` (seconds since the last completed
+        unit) is exactly what lets the monitor call the run *hung* rather
+        than merely slow, and the record's continued arrival is what
+        distinguishes hung from *dead*."""
+        self._emit_heartbeat("watchdog", since_progress_s=since_progress_s)
+
+    def _update_status(self, **extra) -> None:
+        """Rebuild the exporter's status snapshot from the live counters
+        (called at the existing sync points only — never the hot path).
+        One reference assignment publishes it; HTTP threads read the
+        complete dict it points at (``telemetry/exporter.py``)."""
+        if self.exporter is None or not self.exporter.enabled:
+            return
+        sig = self._doctor_signals()
+        scores = telemetry_doctor.scalar_fields(sig)
+        verdict, worst = "healthy", 0.0
+        for kind, score in scores.items():
+            if kind != "healthy" and score >= 1.0 and score > worst:
+                verdict, worst = kind, score
+        snap = {
+            "run_dir": self.save_folder,
+            "pid": os.getpid(),
+            "t_wall": time.time(),
+            "phase": "training",
+            "epoch": self.cur_epoch,
+            "nonfinite_steps": self.nonfinite_steps,
+            "hung_steps": self._hung_steps,
+            "late_compiles": self._late_compiles,
+            "anomaly_counts": dict(self._anomaly_counts),
+            "doctor_scores": scores,
+            "verdict": verdict,
+        }
+        if self.goodput is not None:
+            snap["goodput_seconds"] = self.goodput.to_state()
+            snap["goodput_fractions"] = self.goodput.fractions()
+            snap["steady_fractions"] = telemetry_doctor.steady_fractions(
+                snap["goodput_seconds"]
+            )
+        if self._last_step_ms is not None:
+            snap["step_ms"] = self._last_step_ms
+            mfu = telemetry_mfu.mfu_value(
+                self._flops_per_step or 0.0,
+                self._last_step_ms / 1e3,
+                self._peak_flops,
+            )
+            if mfu is not None:
+                snap["mfu"] = mfu
+        snap.update(extra)
+        self._status = snap
+
     def _maybe_probe_mfu(self) -> None:
         """One-time XLA cost-analysis probe for the per-step FLOP count
         (``TrainEngine.step_cost_analysis``): one extra off-hot-path compile
@@ -1369,8 +1522,19 @@ class Trainer:
             return watchdog
         if watchdog is None:
             # max_fires=2: fire 1 = graceful SIGTERM save; fire 2 = the
-            # thread is wedged, hard-exit (_on_hung_step).
-            watchdog = StepWatchdog(timeout, self._on_hung_step, max_fires=2).start()
+            # thread is wedged, hard-exit (_on_hung_step). The patrol hook
+            # keeps heartbeats flowing from the watchdog thread while the
+            # main thread is stuck (ISSUE 15 liveness contract).
+            watchdog = StepWatchdog(
+                timeout,
+                self._on_hung_step,
+                max_fires=2,
+                on_patrol=(
+                    self._heartbeat_patrol
+                    if self._heartbeat_every_s and self.events.enabled
+                    else None
+                ),
+            ).start()
         watchdog.pat()
         return watchdog
 
@@ -1547,6 +1711,33 @@ class Trainer:
                         **mem_fields,
                         **strag,
                     )
+                    # Liveness pulse + exporter snapshot (ISSUE 15): both
+                    # ride this host sync — host counters already in hand,
+                    # zero extra device syncs. The progress-field refresh
+                    # is unconditional (patrol heartbeats must report the
+                    # newest step even when the pulse itself debounces).
+                    hb_fields = {
+                        "epoch": epoch,
+                        "step_in_epoch": step_in_epoch,
+                        "units": getattr(self, "_attempt_units", 0) + executed,
+                        "step_ms": report["step_ms"],
+                    }
+                    if mem_fields.get("live_bytes") is not None:
+                        hb_fields["live_bytes"] = mem_fields["live_bytes"]
+                    self._note_heartbeat_progress(**hb_fields)
+                    self._emit_heartbeat("loop")
+                    status_extra = dict(
+                        step_in_epoch=step_in_epoch,
+                        units=hb_fields["units"],
+                        **mem_fields,
+                    )
+                    if strag.get("straggler_ratio") is not None:
+                        status_extra["straggler_ratio"] = strag["straggler_ratio"]
+                    if m.get("loss_scale") is not None:
+                        status_extra["loss_scale"] = m["loss_scale"]
+                    if m.get("loss") is not None:
+                        status_extra["loss"] = m["loss"]
+                    self._update_status(**status_extra)
                     scale = m.get("loss_scale")
                     if scale is not None:
                         if (
@@ -1813,6 +2004,16 @@ class Trainer:
                 **health,
                 **mem_fields,
                 **epoch_fields,
+            )
+            self._attempt_units = getattr(self, "_attempt_units", 0) + executed
+            self._note_heartbeat_progress(
+                epoch=epoch, step_in_epoch=step_in_epoch,
+                units=self._attempt_units, step_ms=report["step_ms"],
+            )
+            self._emit_heartbeat("loop")
+            self._update_status(
+                step_in_epoch=step_in_epoch, units=self._attempt_units,
+                **mem_fields,
             )
             if self.anomaly_detector is not None:
                 epoch_compiled = (

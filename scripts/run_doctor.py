@@ -49,10 +49,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib  # noqa: E402
 from distributed_training_pytorch_tpu.telemetry import timeline as timeline_lib  # noqa: E402
+from distributed_training_pytorch_tpu.telemetry.events import load_run_events  # noqa: E402
 
 
 def diagnose_run(run_dir: str):
-    events = timeline_lib.load_run_events(run_dir)
+    # The ONE shared reader (telemetry.events.EventFollower) — the same
+    # parse the streaming monitor tails with (ISSUE 15).
+    events = load_run_events(run_dir)
     return doctor_lib.diagnose(events)
 
 

@@ -1,0 +1,462 @@
+#!/usr/bin/env python
+"""Live run monitor — the run doctor, streaming (ISSUE 15).
+
+Tails one or more run directories' ``telemetry/events.jsonl`` while the
+runs are alive and prints the doctor's diagnosis *online* plus the
+liveness verdicts only a live observer can produce
+(``telemetry/monitor.py``): ``training`` / ``stale_heartbeat`` (records
+still arrive but no execution unit completes) / ``dead`` (the log itself
+went silent) / ``finished``. One directory renders the detailed view;
+several render a fleet table, refreshed every ``--interval`` seconds.
+
+Usage::
+
+    python scripts/run_monitor.py RUN_DIR             # follow one run
+    python scripts/run_monitor.py DIR1 DIR2 ...       # fleet table
+    python scripts/run_monitor.py RUN_DIR --once      # one poll + exit code
+    python scripts/run_monitor.py RUN_DIR --once --json
+    python scripts/run_monitor.py RUN_DIR --events E  # append debounced
+                                                      #   `monitor_alert` records
+    python scripts/run_monitor.py --self-test         # CI gate (below)
+
+Alert rules (``telemetry.monitor.AlertConfig`` — all debounced: a rule
+fires once when its condition goes false->true and re-arms only after it
+clears): ``--stale-after`` / ``--dead-after`` liveness ceilings,
+``--data-wait-ceiling`` / ``--checkpoint-ceiling`` steady-state goodput
+fraction ceilings, anomaly kinds, and verdict transitions
+(compile_bound / straggler / comm_heavy crossing score 1.0).
+
+Exit codes (``--once``, and follow mode with ``--exit-on-end``):
+0 = alive-or-finished and clean, 1 = degraded (stale heartbeat, a
+non-healthy verdict, or an alert rule over its line), 2 = dead,
+3 = nothing to monitor (no event log yet).
+
+``--self-test`` (the verify.sh stage; the perf-gate injected-regression
+pattern) drives the monitor against REAL background digits runs through
+the existing fault seams, sharing ``run_doctor._self_test_trainer`` so
+the monitor watches the exact workload the doctor self-diagnoses:
+
+* a clean run must read ``training``/``healthy`` live and ``finished``/
+  ``healthy`` after, with steady-state goodput fractions matching
+  ``run_doctor.py``'s post-hoc fractions to 1e-6 on the same log (and
+  byte-identical diagnosis dicts — the shared-implementation proof);
+* an injected ``FaultPlan("hang")`` must flip the verdict to
+  ``stale_heartbeat`` while the watchdog's patrol heartbeats keep the
+  log breathing (exit 1);
+* SIGKILL mid-hang must flip it to ``dead`` once the log goes silent
+  past the ceiling (exit 2);
+* a loader-sleep run (the ``ShardedLoader.load_delay_s`` seam) followed
+  live must raise exactly ONE debounced ``data_bound`` alert into the
+  ``--events`` JSONL despite polling every 0.3s (exit 1).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib  # noqa: E402
+from distributed_training_pytorch_tpu.telemetry import monitor as monitor_lib  # noqa: E402
+from distributed_training_pytorch_tpu.telemetry.events import (  # noqa: E402
+    EventLog,
+    load_run_events,
+)
+
+SCRIPT = os.path.abspath(__file__)
+
+# ---------------------------------------------------------------------------
+# Rendering
+
+
+_FLEET_COLUMNS = (
+    "run", "status", "verdict", "epoch", "step", "step_ms",
+    "good%", "data%", "ckpt%", "age_s", "alerts",
+)
+
+
+def render_fleet(statuses) -> str:
+    rows = [s.fleet_row() for s in statuses]
+    widths = {
+        c: max(len(c), *(len(str(r[c])) for r in rows)) for c in _FLEET_COLUMNS
+    }
+    lines = ["  ".join(c.ljust(widths[c]) for c in _FLEET_COLUMNS)]
+    for r in rows:
+        lines.append("  ".join(str(r[c]).ljust(widths[c]) for c in _FLEET_COLUMNS))
+    return "\n".join(lines)
+
+
+def render(statuses, as_json: bool) -> str:
+    if as_json:
+        payload = [s.to_dict() for s in statuses]
+        return json.dumps(payload[0] if len(payload) == 1 else payload,
+                          indent=2, sort_keys=True)
+    if len(statuses) == 1:
+        return statuses[0].describe()
+    return render_fleet(statuses)
+
+
+# ---------------------------------------------------------------------------
+# The monitor loop
+
+
+def run_monitor(args) -> int:
+    config = monitor_lib.AlertConfig(
+        stale_after_s=args.stale_after,
+        dead_after_s=args.dead_after,
+        data_wait_frac=args.data_wait_ceiling,
+        checkpoint_frac=args.checkpoint_ceiling,
+    )
+    alert_log = (
+        EventLog(args.events, process_index=0) if args.events else None
+    )
+    monitors = [
+        monitor_lib.RunMonitor(d, config, alert_log=alert_log)
+        for d in args.run_dir
+    ]
+    try:
+        while True:
+            statuses = [m.poll() for m in monitors]
+            print(render(statuses, args.json))
+            for s in statuses:
+                for a in s.alerts:
+                    print(
+                        f"run_monitor ALERT [{a['rule']}] {s.run_dir}: "
+                        f"value={a.get('value')} threshold={a.get('threshold')} "
+                        f"— {a.get('message', '')}",
+                        file=sys.stderr,
+                    )
+            code = monitor_lib.worst_exit_code(statuses)
+            if args.once:
+                return code
+            if args.exit_on_end and all(
+                s.status in ("finished", "dead") for s in statuses
+            ):
+                return code
+            time.sleep(args.interval)
+    finally:
+        if alert_log is not None:
+            alert_log.close()
+
+
+# ---------------------------------------------------------------------------
+# Self-test: real background digits runs through the existing fault seams.
+# The training harness is run_doctor._self_test_trainer — the monitor
+# watches the exact workload the doctor self-diagnoses, so the two gates
+# cannot drift apart.
+
+_HANG_S = 20.0
+_HB_S = 0.2  # worker heartbeat cadence (the self-test's tightened clock)
+
+
+def _worker_kwargs(case: str) -> dict:
+    from distributed_training_pytorch_tpu.fault import FaultPlan
+    from distributed_training_pytorch_tpu.telemetry import Telemetry
+
+    if case == "healthy":
+        # The doctor self-test's clean shape: one async save with overlap
+        # room (a micro run saving every epoch honestly reads
+        # checkpoint_stall).
+        return dict(
+            max_epoch=3, save_period=3,
+            telemetry=Telemetry(heartbeat_every_s=_HB_S),
+        )
+    if case == "hang":
+        # One long host-side hang in epoch 1 (epoch 0 arms the watchdog —
+        # it pats per completed unit). step_timeout is far above the hang
+        # so the watchdog never SIGTERMs: the point is the PATROL thread's
+        # heartbeats flowing while the main thread sleeps. chain_steps=1
+        # keeps the fault window on the plain single-step path.
+        return dict(
+            max_epoch=3, chain_steps=1, step_timeout=90.0,
+            fault_plan=FaultPlan().add("hang", epoch=1, step=8, payload=_HANG_S),
+            telemetry=Telemetry(anomaly=None, heartbeat_every_s=_HB_S),
+        )
+    if case == "data-wait":
+        # The perf gate / doctor loader seam: every fetch sleeps, the
+        # steady-state data_wait fraction crosses any honest ceiling and
+        # STAYS crossed — the debounce proof.
+        return dict(
+            max_epoch=2, load_delay_s=0.05,
+            telemetry=Telemetry(anomaly=None, heartbeat_every_s=_HB_S),
+        )
+    raise ValueError(f"unknown worker case {case!r}")
+
+
+def train_worker(case: str, run_dir: str) -> int:
+    sys.path.insert(0, os.path.dirname(SCRIPT))
+    import run_doctor
+
+    trainer = run_doctor._self_test_trainer(run_dir, **_worker_kwargs(case))
+    trainer.train()
+    return 0
+
+
+def _spawn_worker(case: str, run_dir: str):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    log = open(os.path.join(run_dir, "worker.log"), "w")  # jaxlint: disable=file-write-without-rank-gate -- single-process CI harness, not a training-job writer
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT, "--train-worker", case, run_dir],
+        stdout=log, stderr=subprocess.STDOUT, env=env,
+    )
+    proc._log_file = log  # closed by _reap
+    return proc
+
+
+def _reap(proc, timeout=180) -> int:
+    try:
+        code = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        code = proc.wait()
+    if getattr(proc, "_log_file", None) is not None:
+        proc._log_file.close()
+    return code
+
+
+def _cli_once(run_dirs, *extra_args, timeout=120):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, *run_dirs, "--once", *extra_args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    return out
+
+
+def _wait_for(predicate, timeout, interval=0.3):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def self_test() -> int:
+    import shutil
+    import tempfile
+
+    failures: list[str] = []
+
+    def check(cond, msg):
+        print(f"run_monitor self-test: {'ok' if cond else 'FAIL'} — {msg}")
+        if not cond:
+            failures.append(msg)
+
+    tight = monitor_lib.AlertConfig(stale_after_s=2.5, dead_after_s=3.0)
+
+    # -- leg 1: healthy — live `training`, post-hoc parity with the doctor
+    tmp_healthy = tempfile.mkdtemp(prefix="run_monitor_healthy_")
+    proc = _spawn_worker("healthy", tmp_healthy)
+    try:
+        live = monitor_lib.RunMonitor(tmp_healthy)
+        seen_training = _wait_for(
+            lambda: live.poll().status == "training", timeout=90
+        )
+        check(seen_training, "healthy run observed live in status 'training'")
+
+        def settled():
+            # Let the steady state accumulate before asserting the CLI's
+            # verdict: the first post-compile sync's tiny denominator is
+            # honest noise, not a diagnosis.
+            st = live.poll()
+            return st.status == "finished" or (
+                st.verdict == "healthy"
+                and st.steady_fractions.get("productive_step", 0.0) > 0.3
+            )
+
+        _wait_for(settled, timeout=90)
+        out = _cli_once([tmp_healthy])
+        check(
+            out.returncode == 0 and "healthy" in out.stdout,
+            f"--once on the live healthy run exits 0 and prints healthy "
+            f"(got rc={out.returncode})",
+        )
+        code = _reap(proc)
+        check(code == 0, f"healthy worker exited 0 (got {code})")
+    finally:
+        _reap(proc, timeout=5)
+
+    # Post-hoc: the monitor and the doctor read the SAME log through the
+    # SAME reader + signal fold — fractions to 1e-6 (they are identical
+    # floats) and byte-identical diagnosis dicts (ISSUE 15 acceptance).
+    post = doctor_lib.diagnose(load_run_events(tmp_healthy))
+    mon_status = monitor_lib.RunMonitor(tmp_healthy).poll()
+    check(
+        mon_status.status == "finished" and mon_status.verdict == "healthy",
+        f"finished healthy run reads finished/healthy "
+        f"(got {mon_status.status}/{mon_status.verdict})",
+    )
+    doctor_fr = doctor_lib.steady_fractions(post.signals.goodput_seconds or {})
+    worst = max(
+        abs(mon_status.steady_fractions.get(b, 0.0) - doctor_fr.get(b, 0.0))
+        for b in doctor_fr
+    )
+    check(
+        worst <= 1e-6,
+        f"monitor steady fractions match run_doctor's to 1e-6 (worst {worst:g})",
+    )
+    check(
+        json.dumps(mon_status.diagnosis.to_dict(), sort_keys=True)
+        == json.dumps(post.to_dict(), sort_keys=True),
+        "streaming and post-hoc diagnoses are byte-identical on the same log",
+    )
+
+    # -- legs 2+3: hang -> stale_heartbeat, SIGKILL -> dead
+    tmp_hang = tempfile.mkdtemp(prefix="run_monitor_hang_")
+    proc = _spawn_worker("hang", tmp_hang)
+    try:
+        live = monitor_lib.RunMonitor(tmp_hang, tight)
+
+        def deep_in_hang():
+            st = live.poll()
+            return (
+                st.status == "stale_heartbeat"
+                and (st.progress_age_s or 0.0) >= 3.5
+            )
+
+        check(
+            _wait_for(deep_in_hang, timeout=120),
+            "injected hang read as stale_heartbeat (patrol heartbeats, no unit)",
+        )
+        events = load_run_events(tmp_hang)
+        patrol = [
+            r for r in events
+            if r.get("event") == "heartbeat" and r.get("source") == "watchdog"
+        ]
+        check(
+            bool(patrol) and any(
+                float(r.get("since_progress_s") or 0.0) >= 2.0 for r in patrol
+            ),
+            "watchdog patrol heartbeats carry an honest since_progress_s",
+        )
+        out = _cli_once([tmp_hang], "--stale-after", "2.5", "--dead-after", "60")
+        check(
+            out.returncode == 1 and "stale_heartbeat" in out.stdout,
+            f"--once mid-hang exits 1 with stale_heartbeat (got rc={out.returncode})",
+        )
+        proc.send_signal(signal.SIGKILL)
+        _reap(proc, timeout=15)
+        time.sleep(tight.resolved_dead_after() + 1.0)
+        out = _cli_once([tmp_hang], "--stale-after", "2.5", "--dead-after", "3")
+        check(
+            out.returncode == 2 and "dead" in out.stdout,
+            f"--once after SIGKILL exits 2 with dead (got rc={out.returncode})",
+        )
+    finally:
+        _reap(proc, timeout=5)
+
+    # -- leg 4: loader sleep -> exactly ONE debounced data_bound alert
+    tmp_dw = tempfile.mkdtemp(prefix="run_monitor_datawait_")
+    alerts_path = os.path.join(tmp_dw, "alerts.jsonl")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    mon_proc = subprocess.Popen(
+        [
+            sys.executable, SCRIPT, tmp_dw,
+            "--interval", "0.3", "--events", alerts_path, "--exit-on-end",
+            "--stale-after", "60", "--dead-after", "120",
+        ],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    worker = _spawn_worker("data-wait", tmp_dw)
+    wcode = _reap(worker)
+    check(wcode == 0, f"data-wait worker exited 0 (got {wcode})")
+    mcode = _reap(mon_proc, timeout=60)
+    check(
+        mcode == 1,
+        f"follow-mode monitor exits 1 on the data-bound run (got {mcode})",
+    )
+    alert_recs = (
+        load_run_events(alerts_path) if os.path.isfile(alerts_path) else []
+    )
+    data_alerts = [
+        r for r in alert_recs
+        if r.get("event") == "monitor_alert" and r.get("rule") == "data_bound"
+    ]
+    check(
+        len(data_alerts) == 1,
+        f"exactly one debounced data_bound monitor_alert "
+        f"(got {len(data_alerts)} across {len(alert_recs)} records, "
+        f"polled every 0.3s)",
+    )
+
+    # -- leg 5: fleet table over two runs
+    out = _cli_once([tmp_healthy, tmp_dw])
+    base_h = os.path.basename(tmp_healthy)
+    base_d = os.path.basename(tmp_dw)
+    check(
+        base_h in out.stdout and base_d in out.stdout and out.returncode == 1,
+        f"fleet --once renders both runs and exits 1 "
+        f"(data-bound run degraded; got rc={out.returncode})",
+    )
+
+    for tmp in (tmp_healthy, tmp_hang, tmp_dw):
+        shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        print("RUN MONITOR SELF-TEST FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(
+        "run_monitor self-test OK: live healthy + hang->stale_heartbeat + "
+        "SIGKILL->dead + one debounced data_bound alert + fleet table"
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("run_dir", nargs="*", default=[],
+                        help="run directory(ies) (the Trainer save_folder) or "
+                             "direct events.jsonl path(s); several = fleet table")
+    parser.add_argument("--once", action="store_true",
+                        help="one poll, print, exit with the CI code")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="follow-mode poll cadence in seconds (default 2)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable status instead of the console view")
+    parser.add_argument("--events", default=None,
+                        help="append debounced monitor_alert records to this JSONL log")
+    parser.add_argument("--stale-after", type=float, default=120.0,
+                        help="no completed unit for this long => stale_heartbeat")
+    parser.add_argument("--dead-after", type=float, default=None,
+                        help="log silent for this long => dead (default 3x stale)")
+    parser.add_argument("--data-wait-ceiling", type=float,
+                        default=doctor_lib.THRESHOLDS["data_wait_frac"],
+                        help="steady-state data_wait fraction alert ceiling")
+    parser.add_argument("--checkpoint-ceiling", type=float,
+                        default=doctor_lib.THRESHOLDS["checkpoint_frac"],
+                        help="steady-state checkpoint fraction alert ceiling")
+    parser.add_argument("--exit-on-end", action="store_true",
+                        help="follow mode: exit (with the CI code) once every "
+                             "monitored run is finished or dead")
+    parser.add_argument("--self-test", action="store_true",
+                        help="CI gate: drive the monitor against real runs with "
+                             "injected hang/SIGKILL/loader-sleep (verify.sh)")
+    parser.add_argument("--train-worker", default=None,
+                        metavar="CASE", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+    if args.train_worker is not None:
+        if len(args.run_dir) != 1:
+            parser.error("--train-worker takes exactly one run_dir")
+        return train_worker(args.train_worker, args.run_dir[0])
+    if args.self_test:
+        return self_test()
+    if not args.run_dir:
+        parser.error("at least one run_dir is required (or use --self-test)")
+    return run_monitor(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,7 +98,18 @@
 # clean twin's exported timeline must be valid trace-event JSON whose
 # goodput spans re-derive the meter's fractions within epsilon.
 #
-# Stage 13 is the run-comparison gate (ISSUE 14; docs/profiling.md
+# Stage 13 is the live-monitor self-test (ISSUE 15; docs/observability.md
+# "Live monitoring"): run_monitor.py --self-test drives the streaming
+# monitor against real background digits runs through the existing fault
+# seams — a clean run must read training/healthy live and match
+# run_doctor.py's post-hoc steady fractions to 1e-6 (byte-identical
+# diagnoses), an injected FaultPlan hang must flip the verdict to
+# stale_heartbeat while the watchdog's patrol heartbeats keep the log
+# breathing, SIGKILL mid-hang must flip it to dead, a loader-sleep run
+# followed live must raise exactly ONE debounced data_bound alert, and
+# the --once exit codes (0 clean / 1 degraded / 2 dead) are asserted.
+#
+# Stage 14 is the run-comparison gate (ISSUE 14; docs/profiling.md
 # "before/after ritual"): run_compare.py --self-test — identical twin runs
 # must diff clean (no goodput bucket over the noise floor), and three
 # injected known-cause slowdowns (a synthetic 3x convolution, the loader
@@ -108,12 +119,12 @@
 # (step_ms ~76 ms flat for four rounds) must be detected as a flat streak
 # on the committed files themselves.
 #
-# Stage 14 is the ROADMAP.md tier-1 command verbatim.
+# Stage 15 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/14: import health (pytest --collect-only) =="
+echo "== stage 1/15: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -122,7 +133,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/14: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/15: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -148,25 +159,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/14: chained-dispatch retrace guard =="
+echo "== stage 3/15: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/14: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/15: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/14: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/15: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/14: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/15: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -176,26 +187,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/14: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/15: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/14: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/15: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/14: elastic chaos soak (kill on N devices, resume on M) =="
+echo "== stage 9/15: elastic chaos soak (kill on N devices, resume on M) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
   echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
   echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
   exit 11
 fi
 
-echo "== stage 10/14: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 10/15: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -207,7 +218,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 11/14: data-wait gate (clean + injected-starvation self-test) =="
+echo "== stage 11/15: data-wait gate (clean + injected-starvation self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait; then
   echo "DATA-WAIT GATE FAILED — the input pipeline's steady-state data_wait"
   echo "fraction exceeds the PERF_BASELINE.json ceiling (ROADMAP item 5)"
@@ -221,7 +232,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait --inject-data-wait 
 fi
 echo "data-wait gate self-test OK: injected loader sleep correctly failed"
 
-echo "== stage 12/14: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
+echo "== stage 12/15: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   echo "RUN DOCTOR SELF-TEST FAILED — an injected bottleneck was misdiagnosed,"
   echo "the clean twin was not healthy, or the exported timeline broke the"
@@ -229,7 +240,16 @@ if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   exit 13
 fi
 
-echo "== stage 13/14: run-comparison gate (twin-diff + injected attribution + bench history) =="
+echo "== stage 13/15: live-monitor self-test (heartbeat liveness + streaming doctor + alerts) =="
+if ! JAX_PLATFORMS=cpu python scripts/run_monitor.py --self-test; then
+  echo "RUN MONITOR SELF-TEST FAILED — the liveness contract broke: a hang did"
+  echo "not read stale_heartbeat, a SIGKILL did not read dead, the healthy twin"
+  echo "diverged from run_doctor's fractions, or the data_bound alert was not"
+  echo "debounced to exactly one firing (docs/observability.md 'Live monitoring')"
+  exit 15
+fi
+
+echo "== stage 14/15: run-comparison gate (twin-diff + injected attribution + bench history) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_compare.py --self-test; then
   echo "RUN COMPARE SELF-TEST FAILED — identical twins did not diff clean, or"
   echo "an injected known-cause slowdown (3x conv / loader sleep / commit"
@@ -242,7 +262,7 @@ if ! JAX_PLATFORMS=cpu python scripts/bench_history.py --self-test; then
   exit 14
 fi
 
-echo "== stage 14/14: tier-1 test suite =="
+echo "== stage 15/15: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -267,6 +267,14 @@ class CheckpointManager:
             meta["loop"] = {k: int(v) for k, v in loop_state.items()}
         if telemetry is not None:
             meta["telemetry"] = dict(telemetry)
+            # Attempt provenance (ISSUE 16): the restart generation that
+            # wrote this checkpoint rides the telemetry mapping from the
+            # trainer but is hoisted to a first-class meta field — "which
+            # attempt produced the state I'm about to resume from?" is a
+            # recovery question, not a goodput-accounting one, and hoisting
+            # keeps every save path's signature unchanged.
+            if "attempt" in meta["telemetry"]:
+                meta["attempt"] = int(meta["telemetry"].pop("attempt"))
         if sharding is None:
             from distributed_training_pytorch_tpu.parallel.sharding import (
                 sharding_record,

@@ -31,6 +31,15 @@ Injection points wired into the framework:
 ``"corrupt_record"``   :class:`CorruptingSource` raises
                        ``data.records.CorruptRecordError`` for matching
                        record indices — exercising loader skip-and-count.
+``"slow_chip"``        The trainer's straggler sampling point delays one
+                       named local device's shard arrival by a configured
+                       amount (``payload={"device": id, "delay_ms": ms}``)
+                       — a deterministic degraded chip, exercising the
+                       per-chip straggler attribution and the fleet
+                       controller's exclude-and-replan leg without real
+                       hardware asymmetry. Queried via :meth:`FaultPlan.
+                       slow_chip` at sync points, NOT a step kind: it must
+                       not force chained windows into single-step fallback.
 =====================  ======================================================
 
 Determinism: events match on exact (epoch, step) when given, fire at most
@@ -144,6 +153,32 @@ class FaultPlan:
                 f"injected {kind} fault"
                 + (f" (payload={ev.payload!r})" if ev.payload is not None else "")
             )
+
+    def slow_chip(
+        self, device_ids, *, epoch: int | None = None
+    ) -> tuple[int, float] | None:
+        """Degraded-chip query at a straggler sampling point: returns
+        ``(device_id, delay_s)`` for the first matching ``slow_chip`` event
+        whose named device is among ``device_ids``, else ``None``.
+
+        Membership is checked BEFORE the budget is consumed: a plan naming
+        an excluded/absent device (the post-replan topology after the
+        controller dropped the slow chip) must stay inert, not burn its
+        budget against devices it can no longer slow."""
+        ids = {int(d) for d in device_ids}
+        for ev in self.events:
+            if ev.kind != "slow_chip" or ev.count <= 0:
+                continue
+            if ev.epoch is not None and ev.epoch != epoch:
+                continue
+            payload = ev.payload if isinstance(ev.payload, dict) else {}
+            dev = int(payload.get("device", -1))
+            if dev not in ids:
+                continue
+            ev.count -= 1
+            self.fired.append(("slow_chip", {"epoch": epoch, "device": dev}))
+            return dev, float(payload.get("delay_ms", 0.0)) / 1e3
+        return None
 
     def maybe_sigterm(self, *, epoch: int, step: int) -> bool:
         """Deliver a real SIGTERM to this process when scheduled — the same

@@ -75,6 +75,7 @@ __all__ = [
     "validate_topology",
     "replan",
     "replan_accum",
+    "replan_excluding",
     "nearest_divisible_accum",
 ]
 
@@ -294,4 +295,50 @@ def replan(
         old_accum_steps=max(1, int(accum_steps)),
         accum_steps=new_accum,
         reason=f"{direction} {old_devices}->{device_count} devices",
+    )
+
+
+def replan_excluding(
+    record_or_axes: Mapping,
+    device_ids,
+    exclude,
+    *,
+    batch_size: int | None = None,
+    accum_steps: int = 1,
+) -> ElasticPlan:
+    """Re-plan a saved mesh onto the survivors of a degraded fleet: the
+    devices in ``device_ids`` minus the ``exclude`` set — the fleet
+    controller's straggler-remediation entry (ISSUE 16: a persistent
+    ``straggler`` verdict names a chip; the remediation is a restart onto
+    the M−1 healthy devices, solved by the same :func:`replan` rules an
+    ordinary elastic shrink uses).
+
+    ``device_ids`` is the CURRENT topology's device-id set (typically
+    ``[d.id for d in jax.devices()]`` — but plain ints here, so a
+    supervising controller can plan feasibility without a jax backend of
+    its own); ``exclude`` the degraded ids to drop. Excluded ids not
+    present are ignored (the chip may already be gone). Raises
+    :class:`ElasticReplanError` when no devices survive; divisibility
+    failures (a preserved model axis not dividing M−1, the global batch
+    not fitting the shrunk extent) propagate from :func:`replan` — the
+    controller treats any of these as "cannot remediate, surface to a
+    human"."""
+    ids = [int(d) for d in device_ids]
+    dropped = sorted({int(d) for d in exclude} & set(ids))
+    survivors = [d for d in ids if d not in set(dropped)]
+    if not survivors:
+        raise ElasticReplanError(
+            f"excluding {sorted(int(d) for d in exclude)} from devices "
+            f"{sorted(ids)} leaves no survivors — nothing to re-plan onto."
+        )
+    plan = replan(
+        record_or_axes,
+        len(survivors),
+        batch_size=batch_size,
+        accum_steps=accum_steps,
+    )
+    return dataclasses.replace(
+        plan,
+        reason=plan.reason
+        + f" (excluding degraded chip(s) {','.join(str(d) for d in dropped)})",
     )

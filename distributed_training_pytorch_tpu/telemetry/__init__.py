@@ -47,7 +47,12 @@ preemption events, loss-scale state) into one surface:
   (``Telemetry(export_port=...)``): ``/status`` JSON + ``/metrics``
   Prometheus text served from atomically-swapped snapshots of the live
   trainer counters — never blocks the hot loop, degrades to a warning
-  when the port is taken.
+  when the port is taken;
+* :mod:`~.controller` — the closed-loop policy engine (ISSUE 16): per-run
+  state machines turning :class:`~.monitor.MonitorStatus` streams into a
+  bounded, debounced, budgeted remediation-action catalog (restart /
+  exclude-and-replan / knob tune with an A/B-judged keep-or-revert),
+  executed and audited by ``scripts/fleet_controller.py``.
 
 Wire-up: ``Trainer(telemetry="on")`` (or a :class:`Telemetry` instance for
 knobs); entries honor ``TELEMETRY=1``; see ``docs/observability.md``.

@@ -9,9 +9,11 @@ line numbers, timeline track refs) that justify it:
 =====================  ====================================================
 verdict                signature
 =====================  ====================================================
-``compile_bound``      non-probe ``compile`` events in epoch >= 1 — the
-                       steady state is retracing (warmup compiles in epoch
-                       0 are normal and never fire this)
+``compile_bound``      non-probe ``compile`` events past the attempt's
+                       starting epoch — the steady state is retracing
+                       (warmup compiles in the epoch an attempt began at —
+                       epoch 0 cold, the resume epoch after a restart —
+                       are normal and never fire this)
 ``data_bound``         steady-state ``data_wait`` fraction over the ceiling
                        (default 20%) — the input pipeline starves the chips
 ``checkpoint_stall``   steady-state ``checkpoint`` fraction over the
@@ -122,6 +124,15 @@ class Signals:
     anomaly_counts: dict = dataclasses.field(default_factory=dict)
     hung_steps: int = 0
     max_straggler_ratio: float | None = None
+    # Global device id of the chip the worst window blocked on (rides the
+    # same `window` record as the ratio) — the fleet controller's
+    # exclude-and-replan leg needs a NAMED chip, not just a ratio.
+    slowest_chip: int | None = None
+    # Epoch the newest attempt started at (run_start's `epoch` field): a
+    # resumed attempt's first-epoch compiles are warmup exactly like a cold
+    # start's epoch-0 compiles — without this, every controller-restarted
+    # run mid-training would read as compile_bound.
+    start_epoch: int = 0
     late_compiles: int = 0
     comm_frac: float | None = None
     # Evidence rows keyed by verdict kind: lists of {"metric"/"value"/
@@ -170,7 +181,8 @@ class Diagnosis:
             lines.append(f"  {i}. [{v.kind}] score {v.score:.2f} — {v.summary}")
             for row in v.evidence:
                 cite = ", ".join(
-                    f"{k}={row[k]}" for k in ("metric", "value", "threshold", "line", "timeline")
+                    f"{k}={row[k]}"
+                    for k in ("metric", "value", "threshold", "chip", "line", "timeline")
                     if row.get(k) is not None
                 )
                 lines.append(f"       evidence: {cite}")
@@ -212,10 +224,17 @@ def update_signals(sig: Signals, rec: dict) -> None:
         r = float(rec["straggler_ratio"])
         if sig.max_straggler_ratio is None or r > sig.max_straggler_ratio:
             sig.max_straggler_ratio = r
+            chip = rec.get("slowest_chip")
+            sig.slowest_chip = int(chip) if chip is not None else None
             sig.note("straggler_ratio", metric="straggler_ratio", value=round(r, 4),
-                     line=line, timeline="steps")
+                     chip=sig.slowest_chip, line=line, timeline="steps")
+    elif kind == "run_start":
+        # Where THIS attempt began: compiles in its starting epoch are
+        # warmup (a resume recompiles its executables mid-run), not the
+        # retrace signature. Fresh runs start at 0 — identical behavior.
+        sig.start_epoch = int(rec.get("epoch") or 0)
     elif kind == "compile" and rec.get("kind") != "mfu_probe":
-        if int(rec.get("epoch", 0) or 0) >= 1:
+        if int(rec.get("epoch", 0) or 0) > sig.start_epoch:
             sig.late_compiles += 1
             sig.note("compile_bound", metric="late_compile",
                      value=rec.get("executables"), line=line, timeline="markers")
@@ -263,7 +282,8 @@ def _verdicts(sig: Signals) -> list[Verdict]:
     if sig.late_compiles > 0:
         found.append(Verdict(
             "compile_bound", 1.0 + float(sig.late_compiles),
-            f"{sig.late_compiles} executable(s) compiled past epoch 0 — the "
+            f"{sig.late_compiles} executable(s) compiled past the attempt's "
+            "warmup epoch — the "
             "steady state is retracing (a shape leak or a lost executable "
             "cache), not warmup",
             sig.evidence.get("compile_bound", [])))
@@ -290,7 +310,10 @@ def _verdicts(sig: Signals) -> list[Verdict]:
         if sig.max_straggler_ratio is not None and (
             sig.max_straggler_ratio >= THRESHOLDS["straggler_ratio"]
         ):
-            parts.append(f"worst slowest-chip ratio {sig.max_straggler_ratio:.2f}")
+            chip = "" if sig.slowest_chip is None else f" (chip {sig.slowest_chip})"
+            parts.append(
+                f"worst slowest-chip ratio {sig.max_straggler_ratio:.2f}{chip}"
+            )
         found.append(Verdict(
             "straggler", strag_score,
             "one chip (or a host-side hang) is pacing the job: " + ", ".join(parts),

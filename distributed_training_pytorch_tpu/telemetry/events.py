@@ -47,12 +47,26 @@ last completed execution unit), debounced to
 ``Telemetry(heartbeat_every_s=...)``; ``monitor_alert`` — a debounced
 alert-rule firing from the streaming monitor (``telemetry/monitor.py``:
 ``rule``, ``run_dir``, ``status``, measured ``value`` vs ``threshold``,
-``message``, emitted by ``scripts/run_monitor.py --events``) — as one
-JSON object per line, machine-readable and append-only. Since schema 2
-every record also carries ``chips`` (this process's local device ids)
-and ``schema`` (:data:`SCHEMA_VERSION`), so per-chip attribution
-survives elastic topology changes and consumers can detect vocabularies
-they predate.
+``message``, emitted by ``scripts/run_monitor.py --events``), and the
+closed-loop layer's record (ISSUE 16: ``controller_action`` — one
+remediation decision by the fleet controller (``telemetry/controller.py``
+via ``scripts/fleet_controller.py``): the ``action`` taken (``restart`` |
+``restart_excluding`` | ``tune`` | ``keep`` | ``revert`` | ``give_up`` |
+``refuse``),
+the ``run_dir`` and ``attempt`` acted on, the triggering ``reason``
+verdict/rule, the justifying ``evidence`` rows copied from the doctor
+verdict or alert that fired, and budget state (``restarts_used`` /
+``max_restarts``, ``backoff_s``); the ``fault_injection`` kind vocabulary
+also gains ``slow_chip``, the deterministic degraded-chip seam of
+``fault/inject.py``) — as one JSON object per line, machine-readable and
+append-only. Since schema 2 every record also carries ``chips`` (this
+process's local device ids) and ``schema`` (:data:`SCHEMA_VERSION`), so
+per-chip attribution survives elastic topology changes and consumers can
+detect vocabularies they predate. Since schema 4, ``run_start`` and
+``heartbeat`` records (and every ``controller_action``) also carry
+``attempt`` — the monotonic per-run-dir attempt id claimed via
+:func:`claim_attempt`, so one appended events.jsonl attributes each
+record to the restart generation that wrote it.
 
 Conventions:
 
@@ -90,7 +104,9 @@ __all__ = [
     "EventFollower",
     "EventLog",
     "SCHEMA_VERSION",
+    "claim_attempt",
     "load_run_events",
+    "peek_attempt",
     "read_events",
     "resolve_events_path",
 ]
@@ -105,8 +121,14 @@ __all__ = [
 #       (``source`` loop|watchdog, ``units``, ``since_progress_s``,
 #       ``goodput_seconds`` snapshot — the liveness pulse) and
 #       ``monitor_alert`` (``rule``, ``status``, ``value``/``threshold``
-#       — a debounced monitor rule firing).
-SCHEMA_VERSION = 3
+#       — a debounced monitor rule firing);
+#   4 — the closed-loop vocabulary (ISSUE 16): ``attempt`` on
+#       ``run_start``/``heartbeat`` (monotonic per-run-dir restart
+#       generation, claimed via :func:`claim_attempt`),
+#       ``controller_action`` (the fleet controller's evidenced
+#       remediation decisions), and ``fault_injection``
+#       ``kind="slow_chip"`` (the degraded-chip seam).
+SCHEMA_VERSION = 4
 
 
 def _jsonable(value: Any) -> Any:
@@ -283,6 +305,44 @@ def resolve_events_path(run_dir: str) -> str:
     if run_dir.endswith(".jsonl") or os.path.isfile(run_dir):
         return run_dir
     return os.path.join(run_dir, "telemetry", "events.jsonl")
+
+
+def _attempt_path(run_dir: str) -> str:
+    """Sidecar path of the attempt counter: next to events.jsonl, NOT inside
+    it — the counter must survive (and be readable before) any event emit,
+    and a controller process must read it without tailing the log."""
+    return os.path.join(run_dir, "telemetry", "attempt")
+
+
+def peek_attempt(run_dir: str) -> int:
+    """The last attempt id claimed for ``run_dir`` (0 when none yet).
+    Stdlib-only and side-effect-free — safe from a supervising controller."""
+    try:
+        with open(_attempt_path(run_dir), encoding="utf-8") as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def claim_attempt(run_dir: str) -> int:
+    """Claim the next monotonic attempt id for ``run_dir`` (1, 2, 3, ...).
+
+    Called once per trainer process at run start (rank 0, telemetry on);
+    the id is stamped on that attempt's ``run_start``/``heartbeat`` records
+    and into checkpoint meta, so one appended events.jsonl — and the
+    checkpoints it describes — attribute every record to the restart
+    generation that wrote it (ISSUE 16). The write is tmp + ``os.replace``
+    so a crash mid-claim never leaves a torn counter; restarts are
+    serialized by the supervisor (a run dir has at most one live trainer),
+    so no cross-process lock is needed."""
+    sidecar = _attempt_path(run_dir)
+    os.makedirs(os.path.dirname(sidecar), exist_ok=True)
+    attempt = peek_attempt(run_dir) + 1
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:  # jaxlint: disable=file-write-without-rank-gate -- call site is process_index()==0-gated in train(); the gate lives with the Telemetry rank check, not in this stdlib helper
+        f.write(f"{attempt}\n")
+    os.replace(tmp, sidecar)
+    return attempt
 
 
 class EventFollower:

@@ -150,6 +150,7 @@ class MonitorStatus:
     headline: dict  # epoch / step_in_epoch / units / step_ms from the last pulse
     alerts: list  # rules that fired THIS poll (debounced)
     active_alerts: tuple  # every rule currently over its line
+    attempt: int | None = None  # restart generation the verdict describes
 
     @property
     def exit_code(self) -> int:
@@ -173,6 +174,7 @@ class MonitorStatus:
             "run_dir": self.run_dir,
             "status": self.status,
             "verdict": self.verdict,
+            "attempt": self.attempt,
             "steady_fractions": self.steady_fractions,
             "last_event_age_s": self.last_event_age_s,
             "progress_age_s": self.progress_age_s,
@@ -226,6 +228,7 @@ class MonitorStatus:
             "run": os.path.basename(os.path.normpath(self.run_dir)) or self.run_dir,
             "status": self.status,
             "verdict": self.verdict,
+            "att": self.attempt if self.attempt is not None else "-",
             "epoch": self.headline.get("epoch", "-"),
             "step": self.headline.get("step_in_epoch", "-"),
             "step_ms": (
@@ -279,12 +282,16 @@ class RunMonitor:
         self._reset_state()
 
     def _reset_state(self) -> None:
-        """Fresh accumulation state — the ctor, and again whenever the
-        follower detects the log was truncated/rotated underneath us: the
-        old Signals describe a file that no longer exists, and folding the
-        re-read records on top would double-count and weld two runs'
-        verdicts together. Alert debounce state resets too (a fresh run's
-        recurrence of a condition is a fresh page)."""
+        """Fresh accumulation state — the ctor, again whenever the follower
+        detects the log was truncated/rotated underneath us, and again on
+        an ``attempt`` change (ISSUE 16: a controller-restarted run APPENDS
+        to the same file, so the generation counter never bumps — the
+        attempt id on ``run_start``/``heartbeat`` records is the in-band
+        restart marker): the old Signals describe a process that no longer
+        exists, and folding the new attempt's records on top would
+        double-count and weld two attempts' verdicts together. Alert
+        debounce state resets too (a fresh attempt's recurrence of a
+        condition is a fresh page — the re-arm-across-restart contract)."""
         self.signals = doctor_lib.Signals()
         self._seen_any = False
         self._run_ended = False
@@ -293,10 +300,28 @@ class RunMonitor:
         self._progress_wall: float | None = None  # when a unit last completed
         self._active: dict[str, bool] = {}  # rule -> currently-over-the-line
         self.headline: dict = {}
+        self._attempt: int | None = None  # last attempt id seen in-band
+        # Cumulative-goodput snapshot at the newest attempt's start: goodput
+        # counters ride checkpoint meta across restarts (trainer resume
+        # path), so the raw cumulative fractions would keep indicting a
+        # disease the restart already cured. Verdicts/alerts are computed
+        # on (cumulative - base) — this attempt's own accrual.
+        self._goodput_base: dict | None = None
 
     # -- ingestion ---------------------------------------------------------
 
     def _ingest(self, rec: dict) -> None:
+        attempt = rec.get("attempt")
+        if isinstance(attempt, int):
+            if self._attempt is not None and attempt != self._attempt:
+                # In-band restart marker (see _reset_state): drop the dead
+                # attempt's accumulation, then rebase goodput at the new
+                # attempt's carried-over snapshot so fraction verdicts
+                # describe THIS attempt, not the welded cumulative.
+                self._reset_state()
+                if isinstance(rec.get("goodput_seconds"), dict):
+                    self._goodput_base = dict(rec["goodput_seconds"])
+            self._attempt = attempt
         doctor_lib.update_signals(self.signals, rec)
         self._seen_any = True
         kind = rec.get("event")
@@ -334,6 +359,22 @@ class RunMonitor:
             ):
                 self._progress_wall = t_wall
 
+    def _scoped_signals(self) -> "doctor_lib.Signals":
+        """The Signals the verdict engine should see: identical to the
+        accumulated ones, except goodput is rebased to the current
+        attempt's own accrual when a restart was observed (cumulative
+        minus the snapshot its ``run_start`` carried). Without a restart
+        this IS ``self.signals`` — byte-identical to the post-hoc doctor's
+        view of the same log."""
+        base = self._goodput_base
+        cum = self.signals.goodput_seconds
+        if not base or not cum:
+            return self.signals
+        rebased = {
+            k: max(0.0, float(v) - float(base.get(k, 0.0))) for k, v in cum.items()
+        }
+        return dataclasses.replace(self.signals, goodput_seconds=rebased)
+
     # -- liveness ----------------------------------------------------------
 
     def _freshness(self) -> float | None:
@@ -366,7 +407,7 @@ class RunMonitor:
 
     # -- alert rules (debounced) -------------------------------------------
 
-    def _evaluate_alerts(self, status: str, diagnosis, fractions, now) -> list:
+    def _evaluate_alerts(self, status: str, diagnosis, fractions, now, sig) -> list:
         cfg = self.config
         fired: list[dict] = []
 
@@ -402,7 +443,7 @@ class RunMonitor:
         )
         steady = sum(
             float(v)
-            for b, v in (self.signals.goodput_seconds or {}).items()
+            for b, v in (sig.goodput_seconds or {}).items()
             if b not in doctor_lib._EXCLUDED
         )
         fractions_armed = steady >= cfg.min_steady_s
@@ -421,7 +462,7 @@ class RunMonitor:
             message="steady-state checkpoint fraction over the alert ceiling",
         )
         for kind in cfg.anomaly_kinds:
-            n = int(self.signals.anomaly_counts.get(kind, 0))
+            n = int(sig.anomaly_counts.get(kind, 0))
             rule(
                 f"anomaly:{kind}",
                 n > 0,
@@ -473,8 +514,9 @@ class RunMonitor:
             self._drained_tail = True
             for rec in self._follower.poll(final=True):
                 self._ingest(rec)
-        diagnosis = doctor_lib.diagnose(self.signals) if self._seen_any else None
-        fractions = doctor_lib.steady_fractions(self.signals.goodput_seconds or {})
+        sig = self._scoped_signals()
+        diagnosis = doctor_lib.diagnose(sig) if self._seen_any else None
+        fractions = doctor_lib.steady_fractions(sig.goodput_seconds or {})
         if status in ("stale_heartbeat", "dead"):
             verdict = status
         elif diagnosis is not None:
@@ -482,7 +524,7 @@ class RunMonitor:
         else:
             verdict = "healthy"
         fresh = self._freshness()
-        alerts = self._evaluate_alerts(status, diagnosis, fractions, now)
+        alerts = self._evaluate_alerts(status, diagnosis, fractions, now, sig)
         return MonitorStatus(
             run_dir=self.run_dir,
             status=status,
@@ -498,4 +540,5 @@ class RunMonitor:
             headline=dict(self.headline),
             alerts=alerts,
             active_alerts=tuple(k for k, on in self._active.items() if on),
+            attempt=self._attempt,
         )

@@ -61,7 +61,7 @@ FIELDS = (
 )
 
 
-def sample_arrivals(metric_tree) -> dict:
+def sample_arrivals(metric_tree, *, slow_chip: tuple[int, float] | None = None) -> dict:
     """Per-chip arrival sample off one window's device-resident metrics.
 
     ``metric_tree`` is the last executed unit's metrics pytree (device
@@ -72,6 +72,13 @@ def sample_arrivals(metric_tree) -> dict:
     elapsed misattributes the tail). The TOTAL blocking time is what the
     sync's metric fetch would have paid anyway; only the per-device split
     is new information.
+
+    ``slow_chip=(device_id, delay_s)`` is the deterministic degraded-chip
+    seam (``FaultPlan`` kind ``slow_chip``): the named device's shard
+    arrival is delayed by ``delay_s`` before blocking, so its incremental
+    wait — and only its — absorbs the injected tail, exactly as a
+    thermally-throttled chip's would. The delay is host-side ``sleep``, so
+    the fault perturbs *observed timing only*, never the computed numbers.
 
     Returns the :data:`FIELDS` dict, or ``{}`` when there are fewer than
     two addressable shards to compare (nothing to attribute)."""
@@ -86,6 +93,8 @@ def sample_arrivals(metric_tree) -> dict:
     prev = time.perf_counter()
     waits = []
     for shard in shards:
+        if slow_chip is not None and int(shard.device.id) == int(slow_chip[0]):
+            time.sleep(max(float(slow_chip[1]), 0.0))
         try:
             shard.data.block_until_ready()
         except (AttributeError, RuntimeError):

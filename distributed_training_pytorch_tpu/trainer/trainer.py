@@ -90,6 +90,7 @@ from distributed_training_pytorch_tpu.telemetry import (
     GoodputMeter,
     resolve_telemetry,
 )
+from distributed_training_pytorch_tpu.telemetry.events import claim_attempt
 from distributed_training_pytorch_tpu.telemetry import doctor as telemetry_doctor
 from distributed_training_pytorch_tpu.telemetry import mfu as telemetry_mfu
 from distributed_training_pytorch_tpu.telemetry import straggler as straggler_lib
@@ -356,6 +357,12 @@ class Trainer:
         self._straggler_on = self.telemetry is not None and getattr(
             self.telemetry, "straggler", False
         )
+        # Attempt id (ISSUE 16): the monotonic per-run-dir restart
+        # generation, claimed in train() (rank 0, telemetry on) and stamped
+        # on run_start/heartbeat records + checkpoint meta so one appended
+        # events.jsonl attributes every record to the attempt that wrote
+        # it. 0 = unclaimed (telemetry off / non-zero rank).
+        self._attempt = 0
         self._last_straggler: dict | None = None
         self._max_straggler_ratio: float | None = None
         # Live doctor signals (telemetry/doctor.py): per-kind anomaly
@@ -366,6 +373,9 @@ class Trainer:
         self._anomaly_counts: dict[str, int] = {}
         self._hung_steps = 0
         self._late_compiles = 0
+        # Epoch this attempt began at (set after restore in train()):
+        # compiles there are warmup, not the compile_bound retrace signal.
+        self._start_epoch = 0
         self._peak_flops = 0.0  # finalized after mesh selection below
         # Live-operations layer (ISSUE 15; docs/observability.md "Live
         # monitoring"): the heartbeat pulse + the optional in-process
@@ -668,13 +678,16 @@ class Trainer:
         """The epoch loop — structural twin of ``trainer/trainer.py:104-181``."""
         self._install_sigterm()
         self.metrics_writer.reopen()  # symmetric with the close() below
+        self._start_epoch = self.cur_epoch  # warmup epoch for late-compile
         if self.goodput is not None:
             self.goodput.start()
         if self.events.enabled:
             # guarded like run_end: the field build includes an
             # int(self.state.step) device fetch the telemetry-off
             # (historical) path must not pay
+            self._attempt = claim_attempt(self.save_folder)
             fields = dict(
+                attempt=self._attempt,
                 epoch=self.cur_epoch,
                 max_epoch=self.max_epoch,
                 step=int(self.state.step),
@@ -1063,11 +1076,16 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _telemetry_meta(self) -> dict | None:
-        """Cumulative telemetry counters for checkpoint meta — currently the
-        goodput buckets, so goodput accounting survives kill/resume."""
-        if self.goodput is None:
-            return None
-        return {"goodput": self.goodput.to_state()}
+        """Cumulative telemetry counters for checkpoint meta — the goodput
+        buckets (so goodput accounting survives kill/resume) plus the
+        attempt id that wrote the checkpoint (ISSUE 16 provenance; the
+        manager hoists it to a first-class ``meta["attempt"]``)."""
+        meta = {}
+        if self.goodput is not None:
+            meta["goodput"] = self.goodput.to_state()
+        if self._attempt:
+            meta["attempt"] = self._attempt
+        return meta or None
 
     def _flush_saver_logged(self) -> None:
         """Flush the async saver, reporting — never raising — a background
@@ -1233,6 +1251,8 @@ class Trainer:
             self._hb_last_emit = now
             fields = dict(self._hb_fields)
         fields.update(extra)
+        if self._attempt:
+            fields["attempt"] = self._attempt
         if self.goodput is not None:
             # GoodputMeter's bucket keys are fixed at construction, so a
             # patrol-thread read races only float value updates — safe.
@@ -1642,7 +1662,30 @@ class Trainer:
             # sampling per-shard arrival order now observes WHICH chip the
             # sync is waiting on, at zero extra device syncs (the total
             # blocking time is the same either way).
-            strag = straggler_lib.sample_arrivals(last) if self._straggler_on else {}
+            slow = None
+            if self._straggler_on and self.fault_plan is not None:
+                # Degraded-chip seam (ISSUE 16): a scheduled `slow_chip`
+                # fault delays the named local device's shard arrival
+                # inside the sample below — timing-only, numbers untouched.
+                # Queried here (a sync point), NOT in the step loop: it
+                # must never force chained windows into single-step mode.
+                slow = self.fault_plan.slow_chip(
+                    (d.id for d in jax.local_devices()), epoch=epoch
+                )
+                if slow is not None:
+                    self.events.emit(
+                        "fault_injection",
+                        kind="slow_chip",
+                        epoch=epoch,
+                        step_in_epoch=step_in_epoch,
+                        device=slow[0],
+                        delay_ms=slow[1] * 1e3,
+                    )
+            strag = (
+                straggler_lib.sample_arrivals(last, slow_chip=slow)
+                if self._straggler_on
+                else {}
+            )
             m = {
                 k: float(v[-1]) if n_last > 1 else float(v) for k, v in last.items()
             }
@@ -1785,9 +1828,10 @@ class Trainer:
             if tm is not None:
                 tm.tick("compile" if traced else "productive_step")
             if traced:
-                if epoch >= 1:
-                    # Epoch 0 compiles are warmup; a compile in the steady
-                    # state is the retrace signature the doctor's
+                if epoch > self._start_epoch:
+                    # Compiles in the attempt's starting epoch (0 cold, the
+                    # resume epoch after a restart) are warmup; a compile in
+                    # the steady state is the retrace signature the doctor's
                     # compile_bound verdict keys on.
                     self._late_compiles += 1
                 self.events.emit(

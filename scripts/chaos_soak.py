@@ -75,7 +75,9 @@ import sys
 import tempfile
 import time
 
-MANIFEST_NAME = "manifest.dtp.json"
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ckpt_validate import valid_checkpoints  # noqa: E402  (shared stdlib helper)
+
 STALL_MARKER = "CHAOS_STALL_JSON="
 CHILD_TIMEOUT_S = 300.0  # hard bound per child attempt (compile + epochs)
 TRIGGER_TIMEOUT_S = 120.0  # bound on waiting for a kill trigger
@@ -217,44 +219,6 @@ def _measure_stall(trainer) -> None:
 
 # ---------------------------------------------------------------------------
 # Parent: orchestration, kill schedule, validation (stdlib only — no jax).
-
-
-def valid_checkpoints(weights_dir: str) -> list[str]:
-    """Committed checkpoint names passing manifest validation. A stdlib
-    re-implementation of ``CheckpointManager.validate`` (size + SHA-256 per
-    file), so the soak's 'is there something restorable?' check is
-    independent of the code under test."""
-    names = []
-    if not os.path.isdir(weights_dir):
-        return names
-    for entry in sorted(os.listdir(weights_dir)):
-        if entry.startswith(".") or entry.endswith(".old"):
-            continue
-        path = os.path.join(weights_dir, entry)
-        manifest_path = os.path.join(path, MANIFEST_NAME)
-        if not os.path.isdir(path) or not os.path.isfile(manifest_path):
-            continue
-        try:
-            with open(manifest_path, encoding="utf-8") as f:
-                manifest = json.load(f)
-            ok = True
-            for rel, want in manifest.get("files", {}).items():
-                fp = os.path.join(path, rel)
-                if not os.path.isfile(fp) or os.path.getsize(fp) != want["size"]:
-                    ok = False
-                    break
-                digest = hashlib.sha256()
-                with open(fp, "rb") as f:
-                    for chunk in iter(lambda: f.read(1 << 20), b""):
-                        digest.update(chunk)
-                if digest.hexdigest() != want["sha256"]:
-                    ok = False
-                    break
-        except (OSError, json.JSONDecodeError, KeyError, TypeError):
-            ok = False
-        if ok:
-            names.append(entry)
-    return names
 
 
 class EventTail:

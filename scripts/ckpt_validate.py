@@ -1,0 +1,56 @@
+"""Stdlib-only checkpoint-validity re-check, shared by the acceptance soaks.
+
+``valid_checkpoints`` is a deliberate re-implementation of
+``CheckpointManager.validate`` (per-file size + SHA-256 against the commit
+manifest) using nothing outside the standard library, so the soak parents'
+"is there something restorable on disk?" check cannot share a bug with the
+checkpoint code under test.  Both ``chaos_soak.py`` and
+``fleet_controller.py`` import this one copy (ISSUE 16 satellite), so the
+two acceptance checks cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+MANIFEST_NAME = "manifest.dtp.json"
+
+
+def valid_checkpoints(weights_dir: str) -> list[str]:
+    """Committed checkpoint names passing manifest validation. A stdlib
+    re-implementation of ``CheckpointManager.validate`` (size + SHA-256 per
+    file), so the soak's 'is there something restorable?' check is
+    independent of the code under test."""
+    names = []
+    if not os.path.isdir(weights_dir):
+        return names
+    for entry in sorted(os.listdir(weights_dir)):
+        if entry.startswith(".") or entry.endswith(".old"):
+            continue
+        path = os.path.join(weights_dir, entry)
+        manifest_path = os.path.join(path, MANIFEST_NAME)
+        if not os.path.isdir(path) or not os.path.isfile(manifest_path):
+            continue
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+            ok = True
+            for rel, want in manifest.get("files", {}).items():
+                fp = os.path.join(path, rel)
+                if not os.path.isfile(fp) or os.path.getsize(fp) != want["size"]:
+                    ok = False
+                    break
+                digest = hashlib.sha256()
+                with open(fp, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        digest.update(chunk)
+                if digest.hexdigest() != want["sha256"]:
+                    ok = False
+                    break
+        except (OSError, json.JSONDecodeError, KeyError, TypeError):
+            ok = False
+        if ok:
+            names.append(entry)
+    return names

@@ -223,10 +223,36 @@ def compare_profiles(path_a: str, path_b: str, *, ridge=None, top=6,
     }
 
 
-def compare_runs(path_a: str, path_b: str, *, top=6,
-                 noise_floor=DEFAULT_NOISE_FLOOR) -> dict:
+def steady_diff(seconds_a: dict, seconds_b: dict, *,
+                noise_floor=DEFAULT_NOISE_FLOOR) -> dict:
+    """Steady-state goodput-fraction diff between two bucket-seconds dicts —
+    THE clean check of run-vs-run comparison, shared verbatim with the
+    fleet controller's knob A/B (ISSUE 16: a tune is kept only when this
+    diff says the targeted fraction actually moved, judged by the same
+    code an operator's ``run_compare.py`` would run).
+
+    Fractions are the doctor's steady-state ones (compile/restart/
+    overlapped-commit excluded from the denominator), diffed through the
+    ONE delta-attribution implementation (``profiling.diff``). Returns
+    ``{"rows": [...], "max_delta": float, "clean": bool, "fractions":
+    (a, b)}`` — rows ranked by |delta|, ``clean`` = nothing moved past the
+    noise floor."""
     from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib
 
+    steady_a = doctor_lib.steady_fractions(dict(seconds_a))
+    steady_b = doctor_lib.steady_fractions(dict(seconds_b))
+    rows = diff_lib.attribute_delta(steady_a, steady_b)
+    max_delta = max((abs(r.delta) for r in rows), default=0.0)
+    return {
+        "rows": rows,
+        "max_delta": max_delta,
+        "clean": max_delta <= noise_floor,
+        "fractions": (steady_a, steady_b),
+    }
+
+
+def compare_runs(path_a: str, path_b: str, *, top=6,
+                 noise_floor=DEFAULT_NOISE_FLOOR) -> dict:
     a = load_run_summary(path_a)
     b = load_run_summary(path_b)
     # Per-step wall per goodput bucket (ms): the bucket seconds the doctor
@@ -239,11 +265,11 @@ def compare_runs(path_a: str, path_b: str, *, top=6,
     # The clean check runs on STEADY-STATE fractions (compile/restart/
     # overlapped-commit excluded — the doctor's denominator), so a twin
     # pair differing only in XLA warmup wall still reads clean.
-    steady_a = doctor_lib.steady_fractions(a["goodput_seconds"])
-    steady_b = doctor_lib.steady_fractions(b["goodput_seconds"])
-    steady_rows = diff_lib.attribute_delta(steady_a, steady_b)
-    max_steady_delta = max((abs(r.delta) for r in steady_rows), default=0.0)
-    clean = max_steady_delta <= noise_floor
+    sd = steady_diff(a["goodput_seconds"], b["goodput_seconds"],
+                     noise_floor=noise_floor)
+    steady_rows = sd["rows"]
+    max_steady_delta = sd["max_delta"]
+    clean = sd["clean"]
 
     total_delta = sum(r.delta for r in rows)
     lines = []

@@ -75,7 +75,7 @@ SCRIPT = os.path.abspath(__file__)
 
 
 _FLEET_COLUMNS = (
-    "run", "status", "verdict", "epoch", "step", "step_ms",
+    "run", "status", "verdict", "att", "epoch", "step", "step_ms",
     "good%", "data%", "ckpt%", "age_s", "alerts",
 )
 

@@ -1,0 +1,483 @@
+"""ISSUE 16 tests: the closed-loop fleet controller's policy engine and
+its seams.
+
+Acceptance pillars:
+
+* the :class:`telemetry.controller.RunPolicy` action catalog — dead ->
+  restart (subprocess exit acts immediately, log-silence debounces),
+  persistent named-chip straggler -> exclude-and-replan, persistent
+  tunable alert -> ONE bounded knob change A/B-judged into keep/revert —
+  with every action carrying the evidence rows that justified it;
+* safety rails, test-enforced: the max-restarts budget ends in ONE
+  ``give_up`` then silence, a zero budget refuses outright, exponential
+  backoff gates consecutive respawns, never two concurrent actions per
+  run, and a respawn's verdict-driven triggers stay gated until the NEW
+  attempt reports (no budget-burning flaps off stale status);
+* the monotonic ``attempt`` sidecar (``claim_attempt``/``peek_attempt``);
+* the deterministic degraded-chip seam: ``FaultPlan`` kind ``slow_chip``
+  (membership checked before budget) through
+  ``straggler.sample_arrivals``'s injected delay;
+* ``parallel.elastic.replan_excluding`` — exclusion as a plain elastic
+  shrink, int-only (plannable without a jax backend);
+* the doctor's attempt-aware late-compile rule: a resumed attempt's
+  starting-epoch recompiles are warmup, not the retrace signature.
+"""
+
+import math
+import types
+
+import pytest
+
+from distributed_training_pytorch_tpu.fault.inject import FaultPlan
+from distributed_training_pytorch_tpu.parallel import elastic
+from distributed_training_pytorch_tpu.telemetry import straggler as straggler_lib
+from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib
+from distributed_training_pytorch_tpu.telemetry.controller import (
+    ACTION_KINDS,
+    ControllerConfig,
+    RunPolicy,
+)
+from distributed_training_pytorch_tpu.telemetry.events import (
+    claim_attempt,
+    peek_attempt,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fixtures: fake MonitorStatus / Diagnosis shapes (duck-typed — the policy
+# reads attributes, never isinstance).
+
+
+def _diag(verdicts=(), slowest_chip=None, goodput=None):
+    return types.SimpleNamespace(
+        verdicts=list(verdicts),
+        signals=types.SimpleNamespace(
+            slowest_chip=slowest_chip,
+            goodput_seconds=dict(goodput or {"productive_step": 10.0}),
+        ),
+    )
+
+
+def _verdict(kind, score, evidence=()):
+    return types.SimpleNamespace(kind=kind, score=score, evidence=list(evidence))
+
+
+def _status(
+    status="training",
+    verdict="healthy",
+    attempt=1,
+    fractions=None,
+    active=(),
+    alerts=(),
+    diagnosis=None,
+    last_event_age_s=1.0,
+):
+    return types.SimpleNamespace(
+        run_dir="/run",
+        status=status,
+        verdict=verdict,
+        diagnosis=diagnosis if diagnosis is not None else _diag(),
+        steady_fractions=dict(fractions or {}),
+        last_event_age_s=last_event_age_s,
+        progress_age_s=None,
+        headline={},
+        alerts=list(alerts),
+        active_alerts=tuple(active),
+        attempt=attempt,
+    )
+
+
+def _stub_diff(calls=None):
+    def steady_diff(before, after, *, noise_floor=0.10):
+        if calls is not None:
+            calls.append((dict(before), dict(after), noise_floor))
+        return {"rows": [], "max_delta": 0.0, "clean": True, "fractions": {}}
+
+    return steady_diff
+
+
+# ---------------------------------------------------------------------------
+# Dead -> restart.
+
+
+def test_proc_exit_restarts_immediately_with_evidence():
+    """An abnormal subprocess exit is definitive — no debounce polls —
+    and the action's evidence carries the exit code it acted on."""
+    pol = RunPolicy(ControllerConfig(confirm_polls=5))
+    act = pol.decide(_status(), proc_running=False, exit_code=137, now=0.0)
+    assert act is not None and act.kind == "restart" and act.reason == "dead"
+    assert {"metric": "exit_code", "value": 137} in act.evidence
+    assert act.kind in ACTION_KINDS
+
+
+def test_log_silence_dead_is_debounced():
+    """Monitor-derived death (an adopted run, no exit code) must hold for
+    confirm_polls consecutive polls — one stale read never respawns."""
+    pol = RunPolicy(ControllerConfig(confirm_polls=2))
+    dead = dict(status="dead", verdict="dead", last_event_age_s=240.0)
+    assert pol.decide(_status(**dead), proc_running=True, exit_code=None,
+                      now=0.0) is None
+    # blip clears -> counter resets
+    assert pol.decide(_status(), proc_running=True, exit_code=None,
+                      now=1.0) is None
+    assert pol.decide(_status(**dead), proc_running=True, exit_code=None,
+                      now=2.0) is None
+    act = pol.decide(_status(**dead), proc_running=True, exit_code=None, now=3.0)
+    assert act is not None and act.kind == "restart"
+    assert act.evidence[0]["metric"] == "last_event_age_s"
+
+
+def test_pending_action_blocks_second_decision():
+    """decide() never hands out two concurrent actions: the first stays
+    in flight until note_applied releases it."""
+    pol = RunPolicy(ControllerConfig())
+    act = pol.decide(_status(), proc_running=False, exit_code=1, now=0.0)
+    assert act is not None
+    assert pol.decide(_status(), proc_running=False, exit_code=1, now=0.1) is None
+    pol.note_applied(act, now=0.2)
+    assert pol.restarts_used == 1
+
+
+def test_backoff_budget_give_up_then_silence():
+    """Exponential backoff between respawns; the budget's exhaustion is
+    ONE give_up action, then permanent silence."""
+    pol = RunPolicy(ControllerConfig(max_restarts=3, backoff_s=5.0,
+                                     backoff_factor=2.0, confirm_polls=1))
+
+    def dead(now):
+        return pol.decide(_status(), proc_running=False, exit_code=1, now=now)
+
+    a1 = dead(0.0)
+    assert a1.kind == "restart"
+    pol.note_applied(a1, now=0.0)
+    assert dead(3.0) is None  # inside the 5s backoff window
+    a2 = dead(6.0)
+    assert a2.kind == "restart"
+    pol.note_applied(a2, now=6.0)
+    assert dead(10.0) is None  # backoff doubled: 6 + 10 = 16
+    a3 = dead(17.0)
+    assert a3.kind == "restart"
+    pol.note_applied(a3, now=17.0)
+    assert pol.restarts_used == 3
+    a4 = dead(100.0)
+    assert a4.kind == "give_up" and pol.gave_up
+    assert a4.params["restarts_used"] == 3 and a4.params["max_restarts"] == 3
+    pol.note_applied(a4, now=100.0)
+    assert dead(200.0) is None  # surfaced to a human; nothing more
+
+
+def test_zero_budget_refuses_once():
+    pol = RunPolicy(ControllerConfig(max_restarts=0))
+    act = pol.decide(_status(), proc_running=False, exit_code=1, now=0.0)
+    assert act.kind == "refuse" and pol.gave_up and pol.restarts_used == 0
+    pol.note_applied(act, now=0.0)
+    assert pol.decide(_status(), proc_running=False, exit_code=1,
+                      now=1.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Straggler -> exclude-and-replan.
+
+
+def _straggler_status(attempt=1, chip=3):
+    rows = [{"metric": "straggler_ratio", "value": 2.1, "threshold": 1.5}]
+    return _status(
+        verdict="straggler",
+        attempt=attempt,
+        diagnosis=_diag([_verdict("straggler", 1.4, rows)], slowest_chip=chip),
+    )
+
+
+def test_straggler_needs_persistence_and_a_named_chip():
+    pol = RunPolicy(ControllerConfig(confirm_polls=2))
+    # named chip, first sighting -> confirm counter only
+    assert pol.decide(_straggler_status(), proc_running=True, exit_code=None,
+                      now=0.0) is None
+    # score over the line but NO named chip -> never acts, counter resets
+    anon = _status(verdict="straggler",
+                   diagnosis=_diag([_verdict("straggler", 1.4)], slowest_chip=None))
+    assert pol.decide(anon, proc_running=True, exit_code=None, now=1.0) is None
+    assert pol.decide(_straggler_status(), proc_running=True, exit_code=None,
+                      now=2.0) is None
+    act = pol.decide(_straggler_status(), proc_running=True, exit_code=None,
+                     now=3.0)
+    assert act.kind == "restart_excluding" and act.params["exclude_chip"] == 3
+    assert act.evidence[0]["metric"] == "straggler_ratio"
+    pol.note_applied(act, now=3.0)
+    assert pol.excluded_chips == [3]
+
+
+def test_respawn_gate_blocks_stale_verdicts_until_new_attempt():
+    """After a respawn, the same disease on a status still describing the
+    REPLACED attempt must not re-fire — one incident, one action. The
+    next attempt's own recurrence re-confirms from scratch."""
+    pol = RunPolicy(ControllerConfig(confirm_polls=2, backoff_s=1.0))
+    for now in (0.0, 1.0):
+        act = pol.decide(_straggler_status(), proc_running=True,
+                         exit_code=None, now=now)
+    pol.note_applied(act, now=1.0)
+    # stale attempt-1 status keeps reporting the straggler: gated
+    for now in (5.0, 6.0, 7.0, 8.0):
+        assert pol.decide(_straggler_status(attempt=1), proc_running=True,
+                          exit_code=None, now=now) is None
+    # the new attempt reports the disease again: a fresh confirm cycle
+    assert pol.decide(_straggler_status(attempt=2), proc_running=True,
+                      exit_code=None, now=9.0) is None
+    act2 = pol.decide(_straggler_status(attempt=2), proc_running=True,
+                      exit_code=None, now=10.0)
+    assert act2 is not None and act2.kind == "restart_excluding"
+
+
+def test_proc_death_bypasses_the_respawn_gate():
+    """The gate holds verdict-driven actions only: a respawned child that
+    dies again is a definitive signal and must restart (within budget)."""
+    pol = RunPolicy(ControllerConfig(confirm_polls=1, backoff_s=1.0))
+    a1 = pol.decide(_status(attempt=1), proc_running=False, exit_code=1, now=0.0)
+    pol.note_applied(a1, now=0.0)
+    a2 = pol.decide(_status(attempt=1), proc_running=False, exit_code=1, now=2.0)
+    assert a2 is not None and a2.kind == "restart"
+
+
+# ---------------------------------------------------------------------------
+# Tunable alerts -> ONE bounded knob change, A/B-judged.
+
+
+def _data_bound_status(attempt=1, frac=0.6, active=True, steady=5.0):
+    return _status(
+        verdict="data_bound" if active else "healthy",
+        attempt=attempt,
+        fractions={"productive_step": 1.0 - frac, "data_wait": frac},
+        active=("data_bound",) if active else (),
+        alerts=[{"rule": "data_bound", "value": frac, "threshold": 0.2}]
+        if active
+        else [],
+        diagnosis=_diag(goodput={"productive_step": steady}),
+    )
+
+
+def test_tune_is_bounded_and_ab_keeps_on_improvement():
+    calls = []
+    pol = RunPolicy(
+        ControllerConfig(confirm_polls=2, backoff_s=1.0, max_prefetch=8,
+                         ab_min_steady_s=0.5),
+        knobs={"prefetch_batches": 1, "commit_delay_s": 0.0},
+        steady_diff=_stub_diff(calls),
+    )
+    assert pol.decide(_data_bound_status(), proc_running=True, exit_code=None,
+                      now=0.0) is None
+    tune = pol.decide(_data_bound_status(), proc_running=True, exit_code=None,
+                      now=1.0)
+    assert tune.kind == "tune" and tune.reason == "data_bound"
+    # bounded: from the current value to the cap, never past it
+    assert tune.params == {"knob": "prefetch_batches", "from": 1, "to": 8,
+                           "bucket": "data_wait"}
+    assert tune.evidence[0]["rule"] == "data_bound"
+    pol.note_applied(tune, now=1.0)
+    assert pol.knobs["prefetch_batches"] == 8
+    # the cured NEW attempt, past backoff, enough steady wall -> keep
+    cured = _data_bound_status(attempt=2, frac=0.05, active=False)
+    keep = pol.decide(cured, proc_running=True, exit_code=None, now=5.0)
+    assert keep.kind == "keep" and keep.params["value"] == 8
+    assert keep.evidence[0]["before"] == pytest.approx(0.6)
+    assert keep.evidence[0]["after"] == pytest.approx(0.05)
+    # the verdict went through the injected run_compare diff
+    assert calls and calls[0][0]["data_wait"] == pytest.approx(0.6)
+    pol.note_applied(keep, now=5.0)
+    assert pol.restarts_used == 1  # keep is record-only, not a respawn
+
+
+def test_ab_waits_for_the_tuned_attempt_and_steady_floor():
+    pol = RunPolicy(
+        ControllerConfig(confirm_polls=1, backoff_s=0.5, ab_min_steady_s=2.0),
+        knobs={"prefetch_batches": 1},
+        steady_diff=_stub_diff(),
+    )
+    tune = pol.decide(_data_bound_status(), proc_running=True, exit_code=None,
+                      now=0.0)
+    pol.note_applied(tune, now=0.0)
+    # still the pre-tune attempt -> no verdict
+    assert pol.decide(_data_bound_status(attempt=1, frac=0.05, active=False),
+                      proc_running=True, exit_code=None, now=2.0) is None
+    # tuned attempt but under the steady floor -> no verdict
+    assert pol.decide(
+        _data_bound_status(attempt=2, frac=0.05, active=False, steady=0.5),
+        proc_running=True, exit_code=None, now=3.0) is None
+    act = pol.decide(
+        _data_bound_status(attempt=2, frac=0.05, active=False, steady=5.0),
+        proc_running=True, exit_code=None, now=4.0)
+    assert act is not None and act.kind == "keep"
+
+
+def test_ab_reverts_then_recurrence_gives_up():
+    """A tune that does not move the bucket is reverted (one respawn);
+    the same disease recurring after the revert has no further automatic
+    cure — give_up, not a tune/revert flap."""
+    pol = RunPolicy(
+        ControllerConfig(confirm_polls=1, backoff_s=0.5, ab_min_steady_s=0.5),
+        knobs={"prefetch_batches": 1},
+        steady_diff=_stub_diff(),
+    )
+    tune = pol.decide(_data_bound_status(), proc_running=True, exit_code=None,
+                      now=0.0)
+    pol.note_applied(tune, now=0.0)
+    worse = _data_bound_status(attempt=2, frac=0.7)
+    rev = pol.decide(worse, proc_running=True, exit_code=None, now=2.0)
+    assert rev.kind == "revert"
+    assert rev.params["knob"] == "prefetch_batches" and rev.params["to"] == 1
+    pol.note_applied(rev, now=2.0)
+    assert pol.knobs["prefetch_batches"] == 1 and pol.restarts_used == 2
+    # recurrence on the post-revert attempt -> a human's turn
+    act = pol.decide(_data_bound_status(attempt=3), proc_running=True,
+                     exit_code=None, now=10.0)
+    assert act.kind == "give_up" and pol.gave_up
+    assert act.params == {"knob": "prefetch_batches", "state": "reverted"}
+
+
+def test_finished_run_judges_final_without_reverting():
+    """A failed tune on a run that then finished cleanly is recorded as a
+    moot give_up — respawning to revert would redo completed work."""
+    pol = RunPolicy(
+        ControllerConfig(confirm_polls=1, backoff_s=0.5, ab_min_steady_s=0.5),
+        knobs={"prefetch_batches": 1},
+        steady_diff=_stub_diff(),
+    )
+    tune = pol.decide(_data_bound_status(), proc_running=True, exit_code=None,
+                      now=0.0)
+    pol.note_applied(tune, now=0.0)
+    done = _data_bound_status(attempt=2, frac=0.7)
+    done.status = "finished"
+    act = pol.decide(done, proc_running=False, exit_code=0, now=2.0)
+    assert act.kind == "give_up" and "moot" in act.message
+    assert pol.knobs["prefetch_batches"] == 8  # no respawn, no knob rollback
+
+
+# ---------------------------------------------------------------------------
+# The monotonic attempt sidecar.
+
+
+def test_claim_attempt_monotonic_and_peek_is_side_effect_free(tmp_path):
+    run = str(tmp_path / "run")
+    assert peek_attempt(run) == 0
+    assert claim_attempt(run) == 1
+    assert peek_attempt(run) == 1 and peek_attempt(run) == 1
+    assert claim_attempt(run) == 2 and claim_attempt(run) == 3
+    assert peek_attempt(run) == 3
+    # a torn counter degrades to 0, the next claim recovers to 1
+    with open(tmp_path / "run" / "telemetry" / "attempt", "w") as f:
+        f.write("garbage")
+    assert peek_attempt(run) == 0
+    assert claim_attempt(run) == 1
+
+
+# ---------------------------------------------------------------------------
+# The deterministic degraded-chip seam.
+
+
+def test_fault_plan_slow_chip_membership_before_budget():
+    plan = FaultPlan().add("slow_chip", count=1,
+                           payload={"device": 1, "delay_ms": 60.0})
+    # named device absent (post-exclusion topology): inert, budget intact
+    assert plan.slow_chip([0, 2]) is None
+    assert plan.slow_chip([0, 2]) is None
+    hit = plan.slow_chip([0, 1])
+    assert hit == (1, pytest.approx(0.06))
+    assert ("slow_chip", {"epoch": None, "device": 1}) in plan.fired
+    # budget of 1 consumed
+    assert plan.slow_chip([0, 1]) is None
+
+
+def test_fault_plan_slow_chip_epoch_pinned():
+    plan = FaultPlan().add("slow_chip", epoch=2, count=5,
+                           payload={"device": 0, "delay_ms": 10.0})
+    assert plan.slow_chip([0, 1], epoch=1) is None
+    assert plan.slow_chip([0, 1], epoch=2) == (0, pytest.approx(0.01))
+
+
+class _FakeShard:
+    class _Data:
+        @staticmethod
+        def block_until_ready():
+            pass
+
+    class _Device:
+        def __init__(self, i):
+            self.id = i
+
+    def __init__(self, device_id):
+        self.device = self._Device(device_id)
+        self.data = self._Data()
+
+
+class _FakeArray:
+    def __init__(self, n):
+        self.addressable_shards = [_FakeShard(i) for i in range(n)]
+
+
+def test_sample_arrivals_slow_chip_seam_names_the_injected_device():
+    """The slow_chip injection lands as the named device's arrival delay
+    — the attribution machinery then blames exactly that chip, which is
+    what the controller's exclusion decision keys on."""
+    fields = straggler_lib.sample_arrivals({"m": _FakeArray(3)},
+                                           slow_chip=(1, 0.05))
+    assert fields["slowest_chip"] == 1
+    assert fields["chip_skew_ms"] > 30.0
+    # without the seam the same fake fleet shows no straggler
+    fields = straggler_lib.sample_arrivals({"m": _FakeArray(3)})
+    assert fields["chip_skew_ms"] < 30.0
+
+
+# ---------------------------------------------------------------------------
+# replan_excluding: exclusion as a plain elastic shrink.
+
+
+def test_replan_excluding_shrinks_onto_survivors():
+    plan = elastic.replan_excluding({"data": 1, "fsdp": 2}, [0, 1], [1],
+                                    batch_size=128, accum_steps=1)
+    assert sum(plan.new_axes.values()) >= 1
+    assert math.prod(plan.new_axes.values()) == 1  # one survivor
+    assert plan.accum_steps == 2  # global batch preserved via accumulation
+    assert "excluding" in plan.reason
+
+
+def test_replan_excluding_ignores_absent_and_refuses_empty():
+    # excluding an id that is already gone is a no-op shrink
+    plan = elastic.replan_excluding({"data": 4}, [0, 1, 2, 3], [7],
+                                    batch_size=64)
+    assert math.prod(plan.new_axes.values()) == 4
+    with pytest.raises(elastic.ElasticReplanError):
+        elastic.replan_excluding({"data": 2}, [0, 1], [0, 1])
+
+
+# ---------------------------------------------------------------------------
+# Doctor: attempt-aware late-compile accounting.
+
+
+def test_resumed_attempt_starting_epoch_compiles_are_warmup():
+    """A controller-restarted run recompiles its executables in the epoch
+    it resumed at — warmup, exactly like a cold start's epoch-0 compiles.
+    Only compiles PAST the attempt's starting epoch count as retracing."""
+    sig = doctor_lib.Signals()
+    doctor_lib.update_signals(sig, {"event": "run_start", "attempt": 2,
+                                    "epoch": 3})
+    assert sig.start_epoch == 3
+    doctor_lib.update_signals(sig, {"event": "compile", "epoch": 3,
+                                    "seconds": 2.0})
+    assert sig.late_compiles == 0  # the resume's warmup recompile
+    doctor_lib.update_signals(sig, {"event": "compile", "epoch": 4,
+                                    "seconds": 2.0})
+    assert sig.late_compiles == 1  # a genuine mid-run retrace
+
+
+def test_fresh_run_late_compile_rule_unchanged():
+    sig = doctor_lib.Signals()
+    doctor_lib.update_signals(sig, {"event": "run_start", "epoch": 0})
+    doctor_lib.update_signals(sig, {"event": "compile", "epoch": 0,
+                                    "seconds": 2.0})
+    assert sig.late_compiles == 0
+    doctor_lib.update_signals(sig, {"event": "compile", "epoch": 1,
+                                    "seconds": 2.0})
+    assert sig.late_compiles == 1
+    # the MFU probe's one-off compile never counts (existing rule)
+    doctor_lib.update_signals(sig, {"event": "compile", "epoch": 2,
+                                    "kind": "mfu_probe", "seconds": 1.0})
+    assert sig.late_compiles == 1

@@ -425,6 +425,79 @@ def test_monitor_resets_state_on_log_truncation(tmp_path):
     assert st.active_alerts == () and st.exit_code == 0
 
 
+def test_monitor_attempt_change_resets_and_rebases(tmp_path):
+    """ISSUE 16 die-and-restart-in-place: a controller-restarted run
+    APPENDS a new attempt to the same events.jsonl. The in-band attempt
+    id must (a) walk the fleet-table state dead -> training -> healthy,
+    (b) drop the dead attempt's accumulated signals (no welded hung/
+    anomaly counters), and (c) rebase goodput at the restored cumulative
+    snapshot the new run_start carries, so fraction verdicts describe
+    THIS attempt — not the diseased history the restart just cured."""
+    base = time.time()
+    clock = {"now": base + 3.0}
+    run = _mk_run(tmp_path, [
+        _rec("run_start", t_wall=base, t_mono=0.0, attempt=1, epoch=0),
+        _rec("hung_step", t_wall=base + 1.0, t_mono=1.0, timeout_s=5.0),
+        # attempt 1 accrued 80% data_wait before it died
+        _rec("epoch_end", t_wall=base + 2.0, t_mono=2.0, epoch=0,
+             goodput_seconds={"productive_step": 1.0, "data_wait": 4.0}),
+    ])
+    path = os.path.join(run, "telemetry", "events.jsonl")
+    mon = RunMonitor(run, AlertConfig(stale_after_s=5.0),
+                     clock=lambda: clock["now"])
+    st = mon.poll()
+    assert st.attempt == 1 and st.verdict == "data_bound"
+    assert mon.signals.hung_steps == 1  # the hang is on attempt 1's ledger
+    assert "data_bound" in st.active_alerts
+    # silence past 3x stale ceiling: the attempt reads dead
+    clock["now"] = base + 40.0
+    assert mon.poll().status == "dead"
+    # the controller respawned: attempt 2 appends, carrying the restored
+    # cumulative goodput snapshot (trainer restores BEFORE run_start)
+    _append(path, _rec("run_start", t_wall=base + 41.0, t_mono=0.0,
+                       attempt=2, epoch=1,
+                       goodput_seconds={"productive_step": 1.0,
+                                        "data_wait": 4.0}))
+    _append(path, _rec("epoch_end", t_wall=base + 44.0, t_mono=3.0, epoch=1,
+                       goodput_seconds={"productive_step": 4.0,
+                                        "data_wait": 4.1}))
+    clock["now"] = base + 45.0
+    st = mon.poll()
+    assert st.status == "training" and st.attempt == 2
+    # no welded counters: attempt 1's hang is gone, verdict healthy on
+    # attempt 2's OWN accrual (3.0 productive vs 0.1 data_wait), even
+    # though the welded cumulative would still read data_bound
+    assert mon.signals.hung_steps == 0
+    assert st.verdict == "healthy" and "data_bound" not in st.active_alerts
+    assert st.steady_fractions["data_wait"] == pytest.approx(0.1 / 3.1)
+
+
+def test_monitor_alert_rearms_across_attempt_change(tmp_path):
+    """A fresh attempt's recurrence of a disease must ALERT AGAIN: the
+    debounce ledger belongs to the attempt, not the run directory. Two
+    attempts over the line = two firings of the same rule."""
+    base = time.time()
+    run = _mk_run(tmp_path, [
+        _rec("run_start", t_wall=base, t_mono=0.0, attempt=1),
+        _goodput_line(base, 1.0, productive_step=1.0, data_wait=1.0),
+    ])
+    path = os.path.join(run, "telemetry", "events.jsonl")
+    mon = RunMonitor(run, AlertConfig(stale_after_s=600.0),
+                     clock=lambda: base + 2.0)
+    st = mon.poll()
+    assert [a["rule"] for a in st.alerts] == ["data_bound"]
+    assert mon.poll().alerts == []  # debounced while it persists
+    _append(path, _rec("run_start", t_wall=base + 3.0, t_mono=0.0, attempt=2,
+                       goodput_seconds={"productive_step": 1.0,
+                                        "data_wait": 1.0}))
+    _append(path, _rec("epoch_end", t_wall=base + 5.0, t_mono=2.0, epoch=0,
+                       goodput_seconds={"productive_step": 2.0,
+                                        "data_wait": 3.0}))
+    st = mon.poll()
+    assert st.attempt == 2
+    assert [a["rule"] for a in st.alerts] == ["data_bound"]  # re-armed
+
+
 def test_worst_exit_code_aggregation():
     def st(code):
         class S:

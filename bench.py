@@ -217,17 +217,33 @@ def _bench_memory(compiled, include_peak=True, predicted=None):
     return out
 
 
-def _build_vgg16(num_classes, image_size, dtype):
+# BENCH_PALLAS (ISSUE 17): the unified kernel-policy knob (ops/dispatch.py)
+# for the benched model — 1 forces the Pallas hot paths, 0 forces plain,
+# unset keeps each model's auto policy (the historical program, bit-exact).
+# Parsed by the same pallas_from_env the example entries use; every builder
+# receives the resolved tri-state.
+def _bench_pallas():
+    from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
+
+    return pallas_from_env(os.environ.get("BENCH_PALLAS"))
+
+
+def _build_vgg16(num_classes, image_size, dtype, pallas):
     del image_size
-    return VGG16(num_classes=num_classes, dtype=dtype)
+    # Via create_model: VGG16 has no fused-kernel coverage and the factory
+    # records that resolution once when the knob is set (ops/dispatch.py).
+    from distributed_training_pytorch_tpu.models import create_model
+
+    return create_model("vgg16", num_classes, dtype=dtype, pallas=pallas)
 
 
-def _build_vit(num_classes, image_size, dtype):
+def _build_vit(num_classes, image_size, dtype, pallas):
     del image_size
     from distributed_training_pytorch_tpu.models import ViTB16
 
     # BENCH_FLASH: unset/auto -> shape-aware adapter; 1 -> force the Pallas
-    # kernel at any T; 0 -> plain XLA attention.
+    # kernel at any T; 0 -> plain XLA attention. BENCH_PALLAS overrides it
+    # (the unified knob wins over the legacy one, models/vit.py).
     flash_env = os.environ.get("BENCH_FLASH", "auto")
     use_flash = {"auto": None, "1": True, "0": False}[flash_env]
     # BENCH_PAD_SEQ: pad the token stream to this length (0 = off). 256 tiles
@@ -235,17 +251,17 @@ def _build_vit(num_classes, image_size, dtype):
     pad_seq = int(os.environ.get("BENCH_PAD_SEQ", "0")) or None
     return ViTB16(
         num_classes=num_classes, dtype=dtype, use_flash=use_flash,
-        pad_seq_to=pad_seq,
+        pad_seq_to=pad_seq, pallas=pallas,
     )
 
 
-def _build_lm(num_classes, image_size, dtype):
+def _build_lm(num_classes, image_size, dtype, pallas):
     from distributed_training_pytorch_tpu.models import GPTSmall
 
     del num_classes  # byte/GPT-2 vocab is part of the model config
     # image_size = sequence length here; long-context runs stretch max_len
     # with it (the flash kernel auto-routes at T>=512).
-    return GPTSmall(dtype=dtype, max_len=max(1024, image_size))
+    return GPTSmall(dtype=dtype, max_len=max(1024, image_size), pallas=pallas)
 
 
 def _image_batch(rng, batch, size, num_classes, model):
@@ -324,11 +340,12 @@ BENCH_MODELS = {
         # isolation, but the full step measures SLOWER (fusion-barrier cost;
         # BASELINE.md "ResNet-50" r5 section) — the flag exists to reproduce
         # that measurement, not as a perf default.
-        "build": lambda n, size, dtype: __import__(
+        "build": lambda n, size, dtype, pallas: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ResNet50"]
         ).ResNet50(
             num_classes=n, dtype=dtype,
             pallas_1x1=os.environ.get("BENCH_PALLAS_1X1", "0") == "1",
+            pallas=pallas,
         ),
         "flops": resnet_train_flops_per_image,
         "batch": 256,
@@ -337,9 +354,9 @@ BENCH_MODELS = {
         "metric": "images/sec/chip (ResNet-50, ImageNet-shape, bf16)",
     },
     "convnext_l": {
-        "build": lambda n, size, dtype: __import__(
+        "build": lambda n, size, dtype, pallas: __import__(
             "distributed_training_pytorch_tpu.models", fromlist=["ConvNeXtL"]
-        ).ConvNeXtL(num_classes=n, dtype=dtype),
+        ).ConvNeXtL(num_classes=n, dtype=dtype, pallas=pallas),
         "flops": convnext_train_flops_per_image,
         # r4 sweep: plain-step img/s rises monotonically to microbatch 128
         # (402@32, 441@64, 452@96, 475@128) and cliffs at 192 (405), so the
@@ -426,7 +443,9 @@ def build_bench_setup(model_name: str | None = None, dtype_name: str | None = No
     from distributed_training_pytorch_tpu.parallel import default_sharding_rules
 
     sharding_rules = default_sharding_rules(mesh)
-    model = cfg["build"](cfg["num_classes"], image_size, _bench_dtype(dtype_name))
+    model = cfg["build"](
+        cfg["num_classes"], image_size, _bench_dtype(dtype_name), _bench_pallas()
+    )
     loss_scale = None
     if dtype_name == "fp16":
         from distributed_training_pytorch_tpu.precision import DynamicScale
@@ -1129,6 +1148,18 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
 
 
 def main():
+    # TUNED=1 (ISSUE 17): adopt the committed TUNED.json winner's knobs as
+    # DEFAULTS — chain_steps maps to BENCH_STEPS, pallas to BENCH_PALLAS,
+    # and xla_flags installs into XLA_FLAGS when unset (tuned_defaults does
+    # that, and this runs before the first backend touch). Explicit BENCH_*
+    # env always wins; TUNED unset changes nothing anywhere.
+    from distributed_training_pytorch_tpu.train import autotune as autotune_lib
+
+    tuned = autotune_lib.tuned_defaults()
+    if tuned.get("chain_steps") and "BENCH_STEPS" not in os.environ:
+        os.environ["BENCH_STEPS"] = str(tuned["chain_steps"])
+    if tuned.get("pallas") is not None and "BENCH_PALLAS" not in os.environ:
+        os.environ["BENCH_PALLAS"] = "1" if tuned["pallas"] else "0"
     # BENCH_DTYPE sweep: a comma list runs the whole measurement once per
     # dtype (one json line each — BENCH_r06-style sweeps diff the lines);
     # a single value (or unset) keeps the one-line contract. Every entry is

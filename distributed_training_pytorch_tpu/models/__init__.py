@@ -20,9 +20,24 @@ from distributed_training_pytorch_tpu.models.transformer_lm import (  # noqa: F4
 
 
 def create_model(name: str, num_classes: int, **kwargs):
-    """Model-zoo factory. Names match BASELINE.json configs."""
+    """Model-zoo factory. Names match BASELINE.json configs.
+
+    Every model accepts the unified ``pallas=`` kernel-policy knob
+    (ops/dispatch.py). VGG16 has no fused-kernel coverage (3x3 convs), so the
+    knob is consumed here and the plain resolution recorded — entries can
+    pass ``pallas=`` uniformly without special-casing the model."""
     name = name.lower()
     if name in ("vgg16", "vgg"):
+        pallas = kwargs.pop("pallas", None)
+        if pallas is not None:
+            from distributed_training_pytorch_tpu.ops import dispatch
+
+            dispatch.record(
+                "vgg16",
+                "conv",
+                "plain",
+                reason="no fused-kernel coverage (3x3 convs) — pallas knob is a no-op",
+            )
         return VGG16(num_classes=num_classes, **kwargs)
     if name in ("resnet50", "resnet"):
         return ResNet50(num_classes=num_classes, **kwargs)

@@ -12,7 +12,7 @@ stochastic depth as a per-sample Bernoulli mask fused into the residual add.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -36,11 +36,55 @@ class DropPath(nn.Module):
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
+class PallasDenseAct(nn.Module):
+    """``nn.Dense(features)`` + activation computed by the fused Pallas
+    GEMM+epilogue kernel (``ops.pallas.conv1x1_bn_act_diff`` — a Dense over
+    the last axis IS a 1x1 conv).
+
+    Param names, shapes, dtypes, and initializers match ``nn.Dense`` exactly
+    ("kernel" ``[Cin, Cout]`` lecun_normal, "bias" ``[Cout]`` zeros), and the
+    caller instantiates it under the auto-name the plain Dense would have
+    received — so flipping the kernel knob changes the *program*, never the
+    param tree: inits are bit-identical and checkpoints restore either way
+    (test-enforced in tests/test_dispatch.py)."""
+
+    features: int
+    act: Optional[str] = None  # None | "relu" | "gelu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act_diff
+
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (cin, self.features), jnp.float32
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        x = x.astype(self.dtype)
+        # The Dense bias rides the kernel's affine epilogue (scale=1); the
+        # ones-scale is a literal constant, so its returned cotangent drops
+        # out of the param grads on its own.
+        return conv1x1_bn_act_diff(
+            x,
+            kernel.astype(self.dtype),
+            jnp.ones((self.features,), jnp.float32),
+            bias,
+            relu=False,
+            act=self.act,
+            affine_grads=True,
+        )
+
+
 class ConvNeXtBlock(nn.Module):
     dim: int
     drop_path: float = 0.0
     layer_scale_init: float = 1e-6
     dtype: Any = jnp.float32
+    # ops/dispatch.py kernel knob: True fuses the expand Dense + GELU (the
+    # roofline-named norm+activation epilogue) into one Pallas GEMM pass.
+    # None/False = the historical two-op XLA path, bit-exact.
+    pallas: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -52,9 +96,17 @@ class ConvNeXtBlock(nn.Module):
             dtype=self.dtype,
         )(x)
         y = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, param_dtype=jnp.float32)(y)
-        y = nn.Dense(4 * self.dim, dtype=self.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        if self.pallas is True:
+            # Explicit names pin the auto-names the plain branch would get,
+            # keeping the param tree identical across the knob.
+            y = PallasDenseAct(
+                4 * self.dim, act="gelu", dtype=self.dtype, name="Dense_0"
+            )(y)
+            y = nn.Dense(self.dim, dtype=self.dtype, name="Dense_1")(y)
+        else:
+            y = nn.Dense(4 * self.dim, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.dim, dtype=self.dtype)(y)
         gamma = self.param(
             "layer_scale",
             nn.initializers.constant(self.layer_scale_init),
@@ -74,9 +126,25 @@ class ConvNeXt(nn.Module):
     dims: Sequence[int] = (192, 384, 768, 1536)
     drop_path_rate: float = 0.0
     dtype: Any = jnp.float32
+    # ops/dispatch.py kernel knob: True = fused Pallas expand-Dense+GELU in
+    # every block; False/None = the historical plain program (auto stays off
+    # — promotion is evidence-gated through the autotuner, see
+    # docs/performance.md "Autotuning").
+    pallas: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        from distributed_training_pytorch_tpu.ops import dispatch
+
+        use_pallas = dispatch.conv1x1_policy(
+            "convnext",
+            self.pallas,
+            op="dense_gelu",
+            auto_off_reason=(
+                "auto: opt-in epilogue fusion — flip with pallas=True/PALLAS=1 "
+                "(docs/performance.md)"
+            ),
+        )
         x = x.astype(self.dtype)
         # Stem: 4x4 stride-4 patchify conv + LN.
         x = nn.Conv(self.dims[0], (4, 4), strides=(4, 4), dtype=self.dtype)(x)
@@ -91,7 +159,10 @@ class ConvNeXt(nn.Module):
                 x = nn.Conv(dim, (2, 2), strides=(2, 2), dtype=self.dtype)(x)
             for _ in range(depth):
                 x = ConvNeXtBlock(
-                    dim, drop_path=float(rates[block]), dtype=self.dtype
+                    dim,
+                    drop_path=float(rates[block]),
+                    dtype=self.dtype,
+                    pallas=True if use_pallas else None,
                 )(x, train=train)
                 block += 1
         x = x.mean(axis=(1, 2))

@@ -16,7 +16,7 @@ through ``TrainState.model_state`` (the engine threads mutable collections —
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -119,9 +119,18 @@ class ResNet(nn.Module):
     # Measured slower end-to-end (fusion-barrier cost, BASELINE.md r5) — a
     # measurement knob, not a perf default.
     pallas_1x1: bool = False
+    # The unified kernel-policy knob (ops/dispatch.py): overrides pallas_1x1
+    # when not None. Auto (None) resolves to OFF — the fused 1x1 path is
+    # measured slower end-to-end, so promotion stays evidence-gated.
+    pallas: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        from distributed_training_pytorch_tpu.ops import dispatch
+
+        pallas_1x1 = dispatch.conv1x1_policy(
+            "resnet", self.pallas, legacy=self.pallas_1x1
+        )
         x = x.astype(self.dtype)
         x = nn.Conv(
             self.width,
@@ -148,7 +157,7 @@ class ResNet(nn.Module):
                     self.width * (2**stage),
                     strides=2 if stage > 0 and block == 0 else 1,
                     dtype=self.dtype,
-                    pallas_1x1=self.pallas_1x1,
+                    pallas_1x1=pallas_1x1,
                 )(x, train=train)
         x = x.mean(axis=(1, 2))  # global average pool
         x = nn.Dense(
@@ -160,14 +169,19 @@ class ResNet(nn.Module):
 
 
 def ResNet50(
-    num_classes: int = 1000, dtype: Any = jnp.float32, pallas_1x1: bool = False
+    num_classes: int = 1000,
+    dtype: Any = jnp.float32,
+    pallas_1x1: bool = False,
+    pallas: Optional[bool] = None,
 ) -> ResNet:
     return ResNet(
         num_classes=num_classes, stage_sizes=(3, 4, 6, 3), dtype=dtype,
-        pallas_1x1=pallas_1x1,
+        pallas_1x1=pallas_1x1, pallas=pallas,
     )
 
 
-def ResNet18Slim(num_classes: int = 10, dtype: Any = jnp.float32) -> ResNet:
+def ResNet18Slim(num_classes: int = 10, dtype: Any = jnp.float32, **kw) -> ResNet:
     """Small bottleneck variant for tests/smoke runs (not torch ResNet-18)."""
-    return ResNet(num_classes=num_classes, stage_sizes=(1, 1, 1, 1), width=16, dtype=dtype)
+    return ResNet(
+        num_classes=num_classes, stage_sizes=(1, 1, 1, 1), width=16, dtype=dtype, **kw
+    )

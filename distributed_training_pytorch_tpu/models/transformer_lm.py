@@ -33,21 +33,24 @@ from distributed_training_pytorch_tpu.parallel.moe import MoEMlp
 
 def _causal_attention_fn(attention_impl: str, mesh):
     """Resolve ``attention_impl`` to a (q, k, v) -> out callable at apply time
-    (lazily, so constructing a model never initializes jax backends)."""
+    (lazily, so constructing a model never initializes jax backends). Flash vs
+    plain goes through the ``ops/dispatch.py`` policy layer, which records the
+    resolution — including the silent below-``FLASH_MIN_SEQ_LEN``
+    fall-through — as a one-time ``kernel_dispatch`` decision."""
+    from distributed_training_pytorch_tpu.ops import dispatch
+
     if attention_impl == "ring":
         if mesh is None:
             raise ValueError('attention_impl="ring" needs mesh=')
         from distributed_training_pytorch_tpu.parallel.ring_attention import ring_attention
 
+        dispatch.record("transformer_lm", "attention", "ring", reason="attention_impl=ring")
         return lambda q, k, v: ring_attention(q, k, v, mesh, causal=True)
-    if attention_impl in ("auto", "flash"):
-        from distributed_training_pytorch_tpu.ops.pallas import make_attention_fn
-
-        if attention_impl == "flash":
-            return make_attention_fn(causal=True, min_seq_len=1)
-        if jax.default_backend() == "tpu":
-            return make_attention_fn(causal=True)
-    if attention_impl in ("auto", "plain"):
+    if attention_impl in ("auto", "flash", "plain"):
+        use_flash = {"auto": None, "flash": True, "plain": False}[attention_impl]
+        fn = dispatch.attention_fn("transformer_lm", use_flash, causal=True)
+        if fn is not None:
+            return fn
         from distributed_training_pytorch_tpu.ops.pallas import _causal_plain
 
         return _causal_plain
@@ -169,6 +172,9 @@ class TransformerLM(nn.Module):
     dropout_rate: float = 0.0
     dtype: Any = jnp.float32
     attention_impl: str = "auto"
+    # The unified kernel-policy knob (ops/dispatch.py): True -> "flash",
+    # False -> "plain", None -> keep attention_impl (the historical program).
+    pallas: Any = None
     mesh: Any = None
     moe_every: int = 0
     num_experts: int = 8
@@ -216,13 +222,16 @@ class TransformerLM(nn.Module):
         else:
             x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        from distributed_training_pytorch_tpu.ops import dispatch
+
+        attention_impl = dispatch.lm_attention_impl(self.attention_impl, self.pallas)
         for i in range(self.depth):
             x = DecoderBlock(
                 self.num_heads,
                 self.mlp_dim,
                 self.dropout_rate,
                 dtype=self.dtype,
-                attention_impl=self.attention_impl,
+                attention_impl=attention_impl,
                 mesh=self.mesh,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 num_experts=self.num_experts,

@@ -46,24 +46,26 @@ def dot_product_attention(q, k, v, *, dtype=jnp.float32, valid_len=None):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
-def default_attention_fn(use_flash: Optional[bool] = None) -> Optional[Callable]:
+def default_attention_fn(
+    use_flash: Optional[bool] = None, *, model: str = "vit"
+) -> Optional[Callable]:
     """Resolve the attention path: the Pallas flash kernel (``ops.pallas``)
     when ``use_flash`` is True (forced, any sequence length), or None (plain
     XLA softmax attention) when False. ``None`` auto-selects: on TPU backends,
     the shape-aware adapter that uses the kernel where it beats XLA
     (T >= ``ops.pallas.FLASH_MIN_SEQ_LEN``) and the plain path below that.
 
+    The resolution goes through the ``ops/dispatch.py`` policy layer, which
+    records it as a one-time ``kernel_dispatch`` decision — including the
+    formerly-silent below-``FLASH_MIN_SEQ_LEN`` fall-through to plain.
+
     Call only at trace/apply time (it touches ``jax.default_backend()``, which
     initializes backends — too early at model-construction time for
     ``jax.distributed`` setups).
     """
-    if use_flash is False:
-        return None
-    from distributed_training_pytorch_tpu.ops.pallas import make_attention_fn
+    from distributed_training_pytorch_tpu.ops import dispatch
 
-    if use_flash is True:
-        return make_attention_fn(min_seq_len=1)  # explicit: force the kernel
-    return make_attention_fn() if jax.default_backend() == "tpu" else None
+    return dispatch.attention_fn(model, use_flash)
 
 
 class MultiHeadAttention(nn.Module):
@@ -143,6 +145,10 @@ class ViT(nn.Module):
     # constructing a model never initializes JAX backends (which would break
     # a later jax.distributed.initialize()).
     use_flash: Optional[bool] = False
+    # The unified kernel-policy knob (ops/dispatch.py): overrides use_flash
+    # when not None (True = force the Pallas kernels, False = plain XLA).
+    # None (default) defers to use_flash — the historical program, bit-exact.
+    pallas: Optional[bool] = None
     # Pad the token stream (cls + patches) up to this length with zero rows
     # right after position embedding — ViT-B's T=197 maps poorly onto the
     # 128-lane MXU/VMEM tiling, and padding to 256 makes every GEMM,
@@ -195,8 +201,16 @@ class ViT(nn.Module):
             valid_len = x.shape[1]
             x = jnp.pad(x, ((0, 0), (0, self.pad_seq_to - valid_len), (0, 0)))
         attention_fn = self.attention_fn
-        if attention_fn is None and self.use_flash is not False:
-            attention_fn = default_attention_fn(self.use_flash)
+        if attention_fn is None:
+            use_flash = self.use_flash if self.pallas is None else self.pallas
+            if use_flash is not False:
+                attention_fn = default_attention_fn(use_flash)
+            else:
+                from distributed_training_pytorch_tpu.ops import dispatch
+
+                dispatch.record(
+                    "vit", "attention", "plain", reason="pallas/use_flash=False"
+                )
         for _ in range(self.depth):
             x = EncoderBlock(
                 self.num_heads,
@@ -221,7 +235,9 @@ def ViTB16(
     """BASELINE config 4. ``use_flash=None`` (auto) routes attention through
     the shape-aware Pallas adapter on TPU — at this model's T=197 that resolves
     to the plain XLA path (measured faster below ``FLASH_MIN_SEQ_LEN``);
-    ``use_flash=True`` forces the fused kernel regardless of shape."""
+    ``use_flash=True`` forces the fused kernel regardless of shape. The
+    unified ``pallas=`` knob (via ``**kw``) overrides the tri-state when set
+    — see ops/dispatch.py."""
     return ViT(
         use_flash=use_flash,
         num_classes=num_classes,

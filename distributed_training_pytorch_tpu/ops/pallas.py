@@ -528,11 +528,36 @@ def _causal_plain(q, k, v):
 # BASELINE.md records the verdict).
 
 
-def _conv1x1_kernel(x_ref, w_ref, a_ref, b_ref, o_ref, *, relu):
+def _resolve_act(relu: bool, act: Optional[str]) -> Optional[str]:
+    """Normalize the epilogue knobs: ``act`` (None/"relu"/"gelu") wins when
+    given; otherwise the legacy ``relu`` bool maps to "relu"/identity."""
+    if act is None:
+        return "relu" if relu else None
+    if act not in ("relu", "gelu"):
+        raise ValueError(f"act must be None, 'relu', or 'gelu' (got {act!r})")
+    return act
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    """``interpret=None`` auto-selects like flash_attention: compiled on TPU,
+    Pallas interpreter elsewhere — the CPU fallback that lets the fused paths
+    run (slowly) under JAX_PLATFORMS=cpu for parity tests."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _conv1x1_kernel(x_ref, w_ref, a_ref, b_ref, o_ref, *, act):
     acc = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
     y = acc * a_ref[:] + b_ref[:]
-    if relu:
+    if act == "relu":
         y = jnp.maximum(y, 0.0)
+    elif act == "gelu":
+        # tanh approximation — matches flax ``nn.gelu`` (approximate=True),
+        # the ConvNeXt expand-Dense epilogue this fusion serves. Computed on
+        # the f32 pre-activation, so the plain-path parity gap is only the
+        # compute-dtype difference (documented tolerance in tests).
+        y = jax.nn.gelu(y, approximate=True)
     o_ref[:] = y.astype(o_ref.dtype)
 
 
@@ -543,19 +568,25 @@ def conv1x1_bn_act(
     bias: jax.Array,
     *,
     relu: bool = True,
+    act: Optional[str] = None,
     block_rows: int = 1024,
     out_dtype=None,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """``relu((x @ w) * scale + bias)`` fused in one Pallas pass.
+    """``act((x @ w) * scale + bias)`` fused in one Pallas pass.
 
     ``x``: ``[..., Cin]`` (e.g. NHWC — leading dims flatten to rows);
     ``w``: ``[Cin, Cout]`` (a 1x1 conv kernel squeezed); ``scale``/``bias``:
-    ``[Cout]`` — the folded BN apply (identity: ones/zeros). Grid over row
+    ``[Cout]`` — the folded BN apply (identity: ones/zeros). The epilogue
+    activation is ``act`` (``"relu"``/``"gelu"``/``None``); when ``act`` is
+    unset the legacy ``relu`` bool picks relu vs identity. Grid over row
     blocks; Cin/Cout stay whole (<= a few hundred channels at ResNet shapes,
     so the weight slab and one x tile sit comfortably in VMEM). Matmul on
     the MXU in f32 accumulation; epilogue on the VPU; output cast to
-    ``out_dtype`` (default: x.dtype)."""
+    ``out_dtype`` (default: x.dtype). ``interpret=None`` auto-selects:
+    compiled on TPU, Pallas interpreter elsewhere."""
+    act = _resolve_act(relu, act)
+    interpret = _resolve_interpret(interpret)
     lead = x.shape[:-1]
     cin = x.shape[-1]
     if w.shape[0] != cin:
@@ -572,7 +603,7 @@ def conv1x1_bn_act(
     a2 = scale.reshape(1, cout).astype(jnp.float32)
     b2 = bias.reshape(1, cout).astype(jnp.float32)
     out = pl.pallas_call(
-        functools.partial(_conv1x1_kernel, relu=relu),
+        functools.partial(_conv1x1_kernel, act=act),
         grid=(n_pad // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, cin), lambda i: (i, 0)),
@@ -587,35 +618,48 @@ def conv1x1_bn_act(
     return out[:n].reshape(*lead, cout)
 
 
-def _conv1x1_fwd(x, w, scale, bias, relu, block_rows, out_dtype, interpret, affine_grads):
+def _conv1x1_fwd(x, w, scale, bias, act, block_rows, out_dtype, interpret, affine_grads):
     y = conv1x1_bn_act(
-        x, w, scale, bias, relu=relu, block_rows=block_rows,
+        x, w, scale, bias, act=act, relu=False, block_rows=block_rows,
         out_dtype=out_dtype, interpret=interpret,
     )
     return y, (x, w, scale, bias, y)
 
 
-def _conv1x1_bwd(relu, block_rows, out_dtype, interpret, affine_grads, res, g):
-    """Standard GEMM backward in XLA dots (same shapes, MXU-friendly):
-    dz = g * 1{y>0} * scale; dx = dz @ w^T; dw = x^T @ dz. With
-    ``affine_grads``, dscale needs the pre-epilogue z — RECOMPUTED as x @ w
-    (inverting the epilogue from y divides by scale, which breaks on the
-    zero-init-gamma BN folds this kernel exists to serve)."""
+def _conv1x1_bwd(act, block_rows, out_dtype, interpret, affine_grads, res, g):
+    """Standard GEMM backward in XLA dots (same shapes, MXU-friendly).
+
+    relu: dz = g * 1{y>0} * scale — the live mask comes free from the saved
+    output, no pre-activation needed. gelu: gelu' needs the pre-activation
+    ``u = z*scale + bias`` — z is RECOMPUTED as x @ w (inverting the epilogue
+    from y divides by scale, which breaks on the zero-init-gamma BN folds
+    this kernel exists to serve) and the exact derivative comes from
+    ``jax.vjp`` of the same tanh-approximate gelu the forward ran. Then
+    dx = dz @ w^T; dw = x^T @ dz; dscale/dbias reduce the epilogue grads."""
     x, w, scale, bias, y = res
     lead = x.shape[:-1]
     cin, cout = w.shape
     g2 = g.reshape(-1, cout).astype(jnp.float32)
-    y2 = y.reshape(-1, cout).astype(jnp.float32)
     x2 = x.reshape(-1, cin)
-    live = (y2 > 0) if relu else jnp.ones_like(y2, jnp.bool_)
-    gz = jnp.where(live, g2, 0.0)
+    z = None
+    if act == "gelu":
+        z = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        u = z * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        _, act_vjp = jax.vjp(lambda t: jax.nn.gelu(t, approximate=True), u)
+        (gz,) = act_vjp(g2)  # grad wrt the pre-activation u
+    elif act == "relu":
+        y2 = y.reshape(-1, cout).astype(jnp.float32)
+        gz = jnp.where(y2 > 0, g2, 0.0)
+    else:
+        gz = g2
     if affine_grads:
         dbias = jnp.sum(gz, axis=0)
-        z = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        if z is None:
+            z = jnp.dot(x2, w, preferred_element_type=jnp.float32)
         dscale = jnp.sum(gz * z, axis=0)
     else:
         # Epilogue declared non-trainable (identity constants): skip the z
-        # recompute GEMM entirely.
+        # recompute GEMM entirely (relu/identity only — gelu already paid it).
         dbias = jnp.zeros_like(bias)
         dscale = jnp.zeros_like(scale)
     dz = gz * scale  # [N, cout] f32
@@ -627,9 +671,9 @@ def _conv1x1_bwd(relu, block_rows, out_dtype, interpret, affine_grads, res, g):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _conv1x1_diff(x, w, scale, bias, relu, block_rows, out_dtype, interpret, affine_grads):
+def _conv1x1_diff(x, w, scale, bias, act, block_rows, out_dtype, interpret, affine_grads):
     return conv1x1_bn_act(
-        x, w, scale, bias, relu=relu, block_rows=block_rows,
+        x, w, scale, bias, act=act, relu=False, block_rows=block_rows,
         out_dtype=out_dtype, interpret=interpret,
     )
 
@@ -644,9 +688,10 @@ def conv1x1_bn_act_diff(
     bias: jax.Array,
     *,
     relu: bool = True,
+    act: Optional[str] = None,
     block_rows: int = 1024,
     out_dtype=None,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
     affine_grads: bool = True,
 ) -> jax.Array:
     """Differentiable :func:`conv1x1_bn_act`: Pallas forward, standard-GEMM
@@ -655,8 +700,9 @@ def conv1x1_bn_act_diff(
 
     ``affine_grads=False`` declares scale/bias non-trainable constants (the
     ``PallasConv1x1`` identity-epilogue use) and returns zero gradients for
-    them, skipping the backward's z-recompute GEMM."""
+    them, skipping the backward's z-recompute GEMM (relu/identity epilogues;
+    gelu recomputes z for its derivative regardless)."""
     return _conv1x1_diff(
-        x, w, scale, bias, relu, block_rows, out_dtype or x.dtype, interpret,
-        affine_grads,
+        x, w, scale, bias, _resolve_act(relu, act), block_rows,
+        out_dtype or x.dtype, _resolve_interpret(interpret), affine_grads,
     )

@@ -58,7 +58,15 @@ verdict/rule, the justifying ``evidence`` rows copied from the doctor
 verdict or alert that fired, and budget state (``restarts_used`` /
 ``max_restarts``, ``backoff_s``); the ``fault_injection`` kind vocabulary
 also gains ``slow_chip``, the deterministic degraded-chip seam of
-``fault/inject.py``) — as one JSON object per line, machine-readable and
+``fault/inject.py``), and the kernel-policy layer's record (ISSUE 17:
+``kernel_dispatch`` — one Pallas-vs-plain path resolution by
+``ops/dispatch.py`` (``model``, ``op``, resolved ``path``
+``pallas``|``plain``|``ring``, the ``reason`` including the
+formerly-silent below-``FLASH_MIN_SEQ_LEN`` fall-through, and ``seq_len``
+where shape-dependent), deduplicated to one record per distinct decision
+per process and forwarded through the sink the Trainer installs for the
+run — so a "tuned" run that quietly lost its kernels is visible to the
+doctor) — as one JSON object per line, machine-readable and
 append-only. Since schema 2 every record also carries ``chips`` (this
 process's local device ids) and ``schema`` (:data:`SCHEMA_VERSION`), so
 per-chip attribution survives elastic topology changes and consumers can
@@ -127,8 +135,12 @@ __all__ = [
 #       generation, claimed via :func:`claim_attempt`),
 #       ``controller_action`` (the fleet controller's evidenced
 #       remediation decisions), and ``fault_injection``
-#       ``kind="slow_chip"`` (the degraded-chip seam).
-SCHEMA_VERSION = 4
+#       ``kind="slow_chip"`` (the degraded-chip seam);
+#   5 — the kernel-policy vocabulary (ISSUE 17): ``kernel_dispatch``
+#       (one ops/dispatch.py Pallas-vs-plain resolution: ``model``,
+#       ``op``, ``path``, ``reason``, optional ``seq_len`` — deduplicated
+#       per distinct decision per process).
+SCHEMA_VERSION = 5
 
 
 def _jsonable(value: Any) -> Any:

@@ -95,11 +95,19 @@ class BenchEntry:
     def series_label(self) -> str:
         """The trajectory this entry belongs to: metric name + the config
         facets a sweep varies (dtype, mesh). Two entries with the same
-        label across rounds are comparable points on one line."""
+        label across rounds are comparable points on one line.
+
+        A facet value the metric string already embeds is NOT repeated:
+        bench's image metrics name their dtype ("... bf16)"), and the
+        explicit ``dtype`` field only appeared mid-history (ISSUE 3) — a
+        redundant facet would split the headline trajectory at the round
+        that introduced the field, hiding exactly the across-rounds
+        comparisons the ledger exists for."""
         parts = [str(self.fields.get("metric", "?"))]
         for facet in ("dtype", "mesh"):
-            if self.fields.get(facet):
-                parts.append(f"{facet}={self.fields[facet]}")
+            value = self.fields.get(facet)
+            if value and str(value) not in parts[0]:
+                parts.append(f"{facet}={value}")
         return " | ".join(parts)
 
     @property

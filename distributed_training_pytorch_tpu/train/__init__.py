@@ -4,4 +4,5 @@ from distributed_training_pytorch_tpu.train.engine import (  # noqa: F401
     TrainEngine,
     make_supervised_loss,
     stack_chain_batch,
+    xla_flag_options,
 )

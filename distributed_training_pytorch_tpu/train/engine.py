@@ -69,6 +69,30 @@ def stack_chain_batch(batch, chain_length: int) -> Any:
     )
 
 
+def xla_flag_options(flags: str | None) -> dict[str, str]:
+    """Parse an ``XLA_FLAGS``-style string into a ``compiler_options`` dict
+    for :meth:`TrainEngine.compile_train_step` /
+    :meth:`TrainEngine.compile_chained_train_steps`.
+
+    ``"--xla_a=true --xla_b=2"`` -> ``{"xla_a": "true", "xla_b": "2"}``; a
+    bare ``--xla_flag`` maps to ``"true"``. This is the bridge the autotuner
+    (``train/autotune.py``) uses to sweep latency-hiding / async-collective
+    flags per-compile instead of mutating the global ``XLA_FLAGS`` env, which
+    only applies at backend init — a sweep that restarts the process per
+    candidate would pay compile + init for every flag set and could never
+    share one warm engine.
+    """
+    options: dict[str, str] = {}
+    for tok in (flags or "").split():
+        if not tok.startswith("--"):
+            raise ValueError(f"XLA flag {tok!r} must start with '--'")
+        key, eq, value = tok[2:].partition("=")
+        if not key.startswith("xla"):
+            raise ValueError(f"{tok!r} is not an --xla_* flag")
+        options[key] = value if eq else "true"
+    return options
+
+
 def make_supervised_loss(model, criterion: Callable) -> LossFn:
     """Build the standard supervised LossFn from a Flax module + criterion.
 

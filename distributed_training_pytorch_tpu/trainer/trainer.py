@@ -720,6 +720,13 @@ class Trainer:
                 batch=self.batch_size,
             )
             self.events.emit("run_start", **fields)
+            # Kernel-policy visibility (ISSUE 17): route ops/dispatch.py's
+            # one-time kernel_dispatch decisions into this run's event log.
+            # Decisions already made while building the model were buffered
+            # by the dispatcher and flush here; uninstalled in the finally.
+            from distributed_training_pytorch_tpu.ops import dispatch as _dispatch
+
+            _dispatch.set_event_sink(self.events.emit)
         # Status exporter (ISSUE 15): rank-0 only, constructed per train()
         # attempt and torn down in the finally below. A taken port warns
         # and disables (never a reason training dies); the run itself is
@@ -773,6 +780,9 @@ class Trainer:
             if self.goodput is not None:
                 self.goodput.stop()
             if self.events.enabled:
+                from distributed_training_pytorch_tpu.ops import dispatch as _dispatch
+
+                _dispatch.clear_event_sink()
                 fields = {
                     "step": int(self.state.step),
                     "epoch": self.cur_epoch,

@@ -25,6 +25,10 @@ from distributed_training_pytorch_tpu.trainer import Trainer
 
 
 class ExampleTrainer(Trainer):
+    # kernel-policy knob (ops/dispatch.py); entries set it from the PALLAS
+    # env (pallas_from_env). None = the historical program.
+    pallas = None
+
     def __init__(
         self,
         train_path: str,
@@ -66,6 +70,17 @@ class ExampleTrainer(Trainer):
         # policy (model_dtype is float32 under the default fp32 policy —
         # reference-parity; Trainer(precision="bf16") switches compute to
         # bf16 with fp32 master weights, docs/mixed_precision.md).
+        if self.pallas is not None:
+            # VGG16 has no fused-kernel coverage — create_model consumes the
+            # knob and records the plain no-op (ops/dispatch.py) instead of
+            # dropping it silently. The None default keeps the historical
+            # constructor path untouched.
+            from distributed_training_pytorch_tpu.models import create_model
+
+            return create_model(
+                "vgg16", num_classes=len(self.labels),
+                dtype=self.model_dtype, pallas=self.pallas,
+            )
         return VGG16(num_classes=len(self.labels), dtype=self.model_dtype)
 
     # mask-weighted metrics below satisfy the padded-validation contract

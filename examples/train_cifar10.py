@@ -9,7 +9,10 @@ back to a synthetic CIFAR-shaped set so the pipeline is still exercisable.
 Env knobs: ``CIFAR10_DIR`` (default ./data/cifar-10-batches-py), ``EPOCHS``
 (default 100), ``BATCH`` (global, default 1024), ``BASE_LR`` (default 0.1,
 linearly scaled by BATCH/256), ``SAVE_DIR`` (default ./runs/cifar10),
-``DTYPE`` (fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
+``DTYPE`` (fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md),
+``PALLAS`` (1|0 kernel-policy knob, unset = per-model auto — ops/dispatch.py),
+``TUNED`` (1 adopts the committed TUNED.json winner's knobs as defaults —
+docs/performance.md "Autotuning").
 """
 
 from __future__ import annotations
@@ -20,12 +23,21 @@ import sys
 
 sys.path.insert(0, ".")
 
+from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
+from distributed_training_pytorch_tpu.train.autotune import tuned_defaults
+
+# TUNED=1 (mirrors DTYPE/CHAIN_STEPS; docs/performance.md "Autotuning"):
+# adopt the committed TUNED.json winner's knobs as DEFAULTS — resolved here,
+# before the first jax use, so a tuned xla_flags win installs into XLA_FLAGS
+# in time for backend init. Explicit env knobs still override; unset TUNED
+# (the default) changes nothing anywhere.
+TUNED = tuned_defaults()
+
 import jax.numpy as jnp
 import numpy as np
 import optax
 
 from distributed_training_pytorch_tpu.data import ArrayDataSource
-from distributed_training_pytorch_tpu.models import VGG16
 from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, warmup_cosine_lr
 from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
@@ -89,6 +101,13 @@ class Cifar10Transform:
 # override agrees with build_model even when the env knob is unset.
 DTYPE = os.environ.get("DTYPE") or None
 
+# PALLAS (mirrors DTYPE/CHAIN_STEPS/MESH): 1 forces the fused Pallas kernel
+# paths, 0 forces plain XLA, unset = per-model auto — for VGG16 every
+# resolution lands on plain (no fused-kernel coverage for 3x3 convs) and the
+# no-op is recorded as a kernel_dispatch event rather than ignored silently
+# (ops/dispatch.py). A kept TUNED.json pallas verdict is the auto default.
+PALLAS = pallas_from_env(default=TUNED.get("pallas"))
+
 
 class Cifar10Trainer(Trainer):
     def __init__(self, data_dir: str, base_lr: float, **kw):
@@ -131,13 +150,19 @@ class Cifar10Trainer(Trainer):
         )
 
     def build_model(self):
+        from distributed_training_pytorch_tpu.models import create_model
         from distributed_training_pytorch_tpu.precision import model_dtype_for_entry
 
-        model = VGG16(
+        # create_model consumes the pallas knob for VGG16 (no fused-kernel
+        # coverage) and records the plain resolution — the knob is uniform
+        # across entries, never silently dropped.
+        model = create_model(
+            "vgg16",
             num_classes=10,
             dtype=model_dtype_for_entry(
                 self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
             ),
+            pallas=PALLAS,
         )
         if self._device_normalize:
             from distributed_training_pytorch_tpu.models import InputNormalizer
@@ -178,7 +203,10 @@ if __name__ == "__main__":
         base_lr=float(os.environ.get("BASE_LR", "0.1")),
         max_epoch=int(os.environ.get("EPOCHS", "100")),
         batch_size=int(os.environ.get("BATCH", "1024")),
-        chain_steps=int(os.environ.get("CHAIN_STEPS", "1")),
+        # explicit CHAIN_STEPS wins; a kept TUNED.json chain_steps is the
+        # default under TUNED=1; otherwise the historical 1.
+        chain_steps=int(os.environ.get("CHAIN_STEPS")
+                        or TUNED.get("chain_steps") or 1),
         # MESH (the CHAIN_STEPS/DTYPE convention): a mesh spec like
         # "fsdp4x2" or "dp2fsdp2tp2" trains sharded end to end
         # (docs/parallelism.md); unset = the historical pure-DP program.

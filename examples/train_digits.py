@@ -25,7 +25,9 @@ Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``EPOCHS`` (default 150),
 fp32 — docs/mixed_precision.md), ``TELEMETRY`` (1 = event log + goodput +
 train-health stats + MFU — docs/observability.md), ``MESH`` (a mesh spec
 like ``fsdp4x2`` or ``dp2fsdp2tp2`` — sharded FSDP/TP training,
-docs/parallelism.md; unset = pure DP).
+docs/parallelism.md; unset = pure DP), ``PALLAS`` (1|0 kernel-policy knob,
+unset = per-model auto — ops/dispatch.py; a no-op recorded as such for
+VGG16).
 """
 
 from __future__ import annotations
@@ -46,6 +48,7 @@ from distributed_training_pytorch_tpu.data.transforms import (
     resize,
 )
 from distributed_training_pytorch_tpu.ops import multistep_lr
+from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
 from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
@@ -69,6 +72,10 @@ def digits_train_transform(height: int, width: int, *, seed: int = 0, p: float =
 
 class DigitsTrainer(ExampleTrainer):
     base_lr = float(os.environ.get("DIGITS_LR", "0.02"))
+    # PALLAS (mirrors DTYPE/CHAIN_STEPS/MESH): kernel-policy knob, resolved
+    # at the entry and passed down as a constructor-level value — the
+    # library never reads env (ops/dispatch.py). Unset = historical program.
+    pallas = pallas_from_env()
 
     def build_train_dataset(self):
         return ImageFolderDataSource(

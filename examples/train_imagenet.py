@@ -23,7 +23,10 @@ env for pods (see run.sh). Env knobs: ``IMAGENET_RECORDS`` (glob or dir of
 (grad-accum microsteps; default 4 for convnext_l else 1), ``BASE_LR``,
 ``IMAGE_SIZE`` (default 224), ``NUM_CLASSES`` (default 1000; 21841 for
 convnext_l), ``SAVE_DIR``, ``SNAPSHOT``, ``PROFILE_DIR``, ``DTYPE``
-(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
+(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md),
+``PALLAS`` (1|0 kernel-policy knob: flash attention for ViT, fused
+GEMM+epilogues for ResNet/ConvNeXt; unset = per-model auto —
+ops/dispatch.py, docs/performance.md "Autotuning").
 """
 
 from __future__ import annotations
@@ -41,6 +44,7 @@ from distributed_training_pytorch_tpu.data import ArrayDataSource, RecordFileSou
 from distributed_training_pytorch_tpu.data import transforms as T
 from distributed_training_pytorch_tpu.models import create_model
 from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, warmup_cosine_lr
+from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
 from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
@@ -126,6 +130,12 @@ class _LimitedSource:
 # an explicit precision= ctor override agrees with build_model.
 DTYPE = os.environ.get("DTYPE") or None
 
+# PALLAS (mirrors DTYPE/CHAIN_STEPS/MESH): 1 forces the fused Pallas paths
+# (ViT flash attention, ResNet conv1x1_bn_act, ConvNeXt dense+gelu), 0
+# forces plain XLA, unset = per-model auto (the historical defaults). Every
+# resolution is recorded as a kernel_dispatch event (ops/dispatch.py).
+PALLAS = pallas_from_env()
+
 
 class ImageNetTrainer(Trainer):
     criterion_uses_mask = True
@@ -191,6 +201,7 @@ class ImageNetTrainer(Trainer):
             dtype=model_dtype_for_entry(
                 self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
             ),
+            pallas=PALLAS,
         )
         if _ship_uint8():
             from distributed_training_pytorch_tpu.models.wrappers import InputNormalizer

@@ -13,7 +13,10 @@ build a real one offline with ``examples/make_lm_corpus.py``), ``SEQ_LEN``
 = GPT-2-small shape), ``SAVE_PERIOD`` / ``LAST_SAVE_PERIOD`` (epochs between
 periodic / `last` saves — raise both when the checkpoint path is slow, e.g.
 a chip behind a relay where a GPT-small save costs minutes), ``DTYPE``
-(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md).
+(fp32|bf16|fp16 mixed-precision policy — docs/mixed_precision.md),
+``PALLAS`` (1|0 kernel-policy knob: forces the flash-attention path on/off;
+unset = the historical auto — ops/dispatch.py, docs/performance.md
+"Autotuning").
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ import optax
 from distributed_training_pytorch_tpu.data import ArrayDataSource
 from distributed_training_pytorch_tpu.models import GPTSmall, LMTiny
 from distributed_training_pytorch_tpu.ops import warmup_cosine_lr
+from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
 from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
@@ -72,6 +76,12 @@ def load_windows(seq_len: int, path: str | None = None) -> np.ndarray:
 # an explicit precision= ctor override agrees with build_model.
 DTYPE = os.environ.get("DTYPE") or None
 
+# PALLAS (mirrors DTYPE/CHAIN_STEPS/MESH): 1 forces the Pallas flash-attention
+# path, 0 forces the plain einsum path, unset = the historical auto (flash on
+# TPU above the sequence-length floor). Every resolution is recorded as a
+# kernel_dispatch event (ops/dispatch.py).
+PALLAS = pallas_from_env()
+
 
 class LMTrainer(Trainer):
     def __init__(self, seq_len: int, base_lr: float, size: str, moe_every: int, **kw):
@@ -103,6 +113,7 @@ class LMTrainer(Trainer):
             ),
             moe_every=self.moe_every,
             max_len=max(self.seq_len, 128),
+            pallas=PALLAS,
         )
 
     criterion_uses_mask = True

@@ -28,7 +28,9 @@ Env knobs: ``DIGITS_DIR`` (default ./data/digits), ``RECORDS_DIR`` (default
 <DIGITS_DIR>/records), ``EPOCHS`` (default 60), ``BATCH`` (global, default
 128), ``RECORDS_LR`` (default 0.1, x BATCH/256), ``SAVE_DIR`` (default
 ./runs/records_digits), ``DTYPE`` (fp32|bf16|fp16 mixed-precision policy —
-docs/mixed_precision.md).
+docs/mixed_precision.md), ``PALLAS`` (1|0 kernel-policy knob: forces the
+fused conv1x1+BN+act Pallas path on/off for the ResNet; unset = the
+historical auto — ops/dispatch.py, docs/performance.md "Autotuning").
 """
 
 from __future__ import annotations
@@ -50,6 +52,7 @@ from distributed_training_pytorch_tpu.data import (
 from distributed_training_pytorch_tpu.data import transforms as T
 from distributed_training_pytorch_tpu.models import InputNormalizer, ResNet18Slim
 from distributed_training_pytorch_tpu.ops import accuracy, cross_entropy_loss, warmup_cosine_lr
+from distributed_training_pytorch_tpu.ops.dispatch import pallas_from_env
 from distributed_training_pytorch_tpu.parallel import mesh_from_env
 from distributed_training_pytorch_tpu.trainer import Trainer
 from distributed_training_pytorch_tpu.utils import Logger
@@ -83,6 +86,12 @@ def pack_digits(digits_dir: str, records_dir: str) -> dict:
 # an explicit precision= ctor override agrees with build_model.
 DTYPE = os.environ.get("DTYPE") or None
 
+# PALLAS (mirrors DTYPE/CHAIN_STEPS/MESH): 1 forces the fused conv1x1+BN+act
+# Pallas path in the ResNet's projection shortcuts, 0 forces plain XLA,
+# unset = the historical auto. Every resolution is recorded as a
+# kernel_dispatch event (ops/dispatch.py).
+PALLAS = pallas_from_env()
+
 
 class RecordsDigitsTrainer(Trainer):
     criterion_uses_mask = True
@@ -114,6 +123,7 @@ class RecordsDigitsTrainer(Trainer):
                 dtype=model_dtype_for_entry(
                 self.precision, DTYPE is not None or self.precision_requested, jnp.bfloat16
             ),
+                pallas=PALLAS,
             ),
             mean=list(T.IMAGENET_MEAN),
             std=list(T.IMAGENET_STD),

@@ -16,15 +16,21 @@ Usage::
     python scripts/bench_history.py --self-test     # CI gate (verify.sh)
 
 ``--self-test`` asserts the detector's acceptance case on the committed
-files themselves: the r02→r05 plateau (step_ms ~76 ms, value ~54k
-img/s/chip, spread 1.4%) MUST be reported as a >= 4-round flat streak on
-both the ``step_ms`` and ``value`` series. If a future round breaks the
-plateau (the ROADMAP item 2 goal), re-anchor the self-test to a synthetic
-fixture — the detector boundary cases stay covered in
-``tests/test_run_compare.py`` either way.
+files themselves, in BOTH directions (re-anchored for ISSUE 17):
 
-Exit codes: 0 ok, 1 self-test failure (expected streak not detected),
-2 no round files found under ``--root``.
+* the historical r02→r05 plateau (step_ms ~76 ms, value ~54k img/s/chip,
+  spread 1.4%) MUST still be reported as a >= 4-round flat streak on both
+  the ``step_ms`` and ``value`` series — ended streaks stay in the ledger;
+* that streak MUST have *ended*: BENCH_r06 (the first autotuned round,
+  ``TUNED.json``) sits outside the flat band, so no flat streak on the
+  headline series may extend to the newest committed round. A future
+  round sequence that re-flattens the line will fail this gate — by
+  design: the detector must never again sit quiet on a live plateau.
+
+The detector boundary cases stay covered in ``tests/test_run_compare.py``.
+
+Exit codes: 0 ok, 1 self-test failure (expected streak not detected, or a
+live flat streak at HEAD), 2 no round files found under ``--root``.
 """
 
 import argparse
@@ -41,7 +47,10 @@ REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
 
 def self_test(report) -> int:
-    """The committed-rounds acceptance check: r02->r05 must read as flat."""
+    """The committed-rounds acceptance check: r02->r05 must read as a flat
+    streak that has ENDED — detected in the ledger, but not extending to
+    the newest committed round of the headline series (BENCH_r06, the
+    autotuned round, must sit outside the band)."""
     failures = []
     for field in ("step_ms", "value"):
         hits = [
@@ -51,20 +60,40 @@ def self_test(report) -> int:
             and s.rounds[0] <= 2
             and s.rounds[-1] >= 5
         ]
-        if hits:
-            print(f"bench_history self-test [{field}]: {hits[0].describe()} — ok")
-        else:
+        if not hits:
             failures.append(
                 f"{field}: no >=4-round flat streak covering r02->r05 "
                 f"(streaks: {[s.describe() for s in report.streaks]})"
             )
+            continue
+        streak = hits[0]
+        last_round = max(r for r, _ in report.series[streak.series])
+        live = [
+            s for s in report.streaks
+            if s.series == streak.series and s.rounds[-1] >= last_round
+        ]
+        if last_round <= streak.rounds[-1]:
+            failures.append(
+                f"{field}: the plateau is the newest data — no round after "
+                f"r{streak.rounds[-1]:02d} on {streak.series} (the flat "
+                "streak was never ended)"
+            )
+        elif live:
+            failures.append(
+                f"{field}: a flat streak extends to the newest round "
+                f"r{last_round:02d} — the bench line is STILL flat at HEAD "
+                f"({live[0].describe()})"
+            )
+        else:
+            print(f"bench_history self-test [{field}]: {streak.describe()} — "
+                  f"detected, ended (r{last_round:02d} is outside the band)")
     if failures:
         print("BENCH HISTORY SELF-TEST FAILED:", file=sys.stderr)
         for f in failures:
             print(f"  - {f}", file=sys.stderr)
         return 1
-    print("bench_history self-test OK: the committed r02->r05 plateau is "
-          "detected on both the step_ms and value trajectories")
+    print("bench_history self-test OK: the r02->r05 plateau is detected on "
+          "both trajectories and ends before the newest committed round")
     return 0
 
 

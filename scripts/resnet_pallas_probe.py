@@ -24,7 +24,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, ".")
 
@@ -32,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act
+from distributed_training_pytorch_tpu.train.autotune import time_chained
 
 HBM_BYTES_PER_S = 819e9  # v5e
 STEPS = int(os.environ.get("STEPS", "20"))
@@ -71,36 +71,12 @@ def pallas_fused(block_rows):
     return f
 
 
-def time_chained(f, x, w, a, b) -> float:
-    """Per-call seconds for f, by TWO-LENGTH DIFFERENCING: the relay's
-    per-dispatch latency (~0.1-0.3 s — 100x this op) is a constant per
-    window, so time a short and a long chain of the same scan body and
-    divide the time difference by the extra trips; the dispatch constant
-    cancels exactly."""
-    import functools
-
-    def body(c, _):
-        wi = (w.astype(jnp.float32) * (1.0 + c)).astype(w.dtype)
-        out = f(x, wi, a, b)
-        # tiny, data-dependent carry: blocks loop-invariant hoisting and CSE
-        return out[:1, :1, :1, :8].astype(jnp.float32).sum() * 1e-30, None
-
-    @functools.partial(jax.jit, static_argnames="length")
-    def chained(x, w, a, b, length):
-        c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=length)
-        return c
-
-    short, long_ = STEPS, 5 * STEPS
-    times = {}
-    for length in (short, long_):
-        _ = float(chained(x, w, a, b, length))  # compile + warm (scalar sync)
-        best = float("inf")
-        for _w in range(WINDOWS):
-            t0 = time.perf_counter()
-            _ = float(chained(x, w, a, b, length))
-            best = min(best, time.perf_counter() - t0)
-        times[length] = best
-    return (times[long_] - times[short]) / (long_ - short)
+# Timing: train.autotune.time_chained — the ONE two-length-differencing
+# scan-chain timer, now shared with the autotuner's candidate measurement
+# (ISSUE 17 moved it there; tests/test_autotune.py AST-enforces that this
+# probe keeps no private copy). Semantics unchanged: per-call seconds as
+# (t_long - t_short) / extra_trips with the weight (arg 1) perturbed per
+# trip by the carried output statistic.
 
 
 def main():
@@ -141,7 +117,7 @@ def main():
             # error computed on device — a full-tensor D2H pull through the
             # relay costs ~1 min per candidate
             err = float(err_of(jax.jit(f)(x, w, a, b), x, w, a, b))
-            dt = time_chained(f, x, w, a, b)
+            dt = time_chained(f, x, w, a, b, steps=STEPS, windows=WINDOWS)
             row[name] = {
                 "ms": round(dt * 1e3, 3),
                 "pct_of_bw_floor": round(floor_ms / (dt * 1e3) * 100, 1),

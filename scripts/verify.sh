@@ -117,9 +117,25 @@
 # attributed to the correct category/bucket with evidence refs — followed
 # by bench_history.py --self-test: the committed BENCH_r02->r05 plateau
 # (step_ms ~76 ms flat for four rounds) must be detected as a flat streak
-# on the committed files themselves.
+# on the committed files themselves — AND must have ended: BENCH_r06 (the
+# autotuned round, ISSUE 17) has to sit outside the flat band, so a future
+# re-flattened line fails this gate instead of sitting quiet.
 #
-# Stage 15 is the fleet-controller soak (ISSUE 16; docs/fault_tolerance.md
+# Stage 15 is the autotuner gate (ISSUE 17; docs/performance.md
+# "Autotuning"): autotune.py --self-test measures a deliberately 3x de-tuned
+# baseline on a tiny CPU workload (the perf-gate inject-slowdown pattern —
+# applied AFTER measurement so the seam cannot leak into candidates), sweeps
+# >= 3 declared chain_steps candidates, and must rank the known-win seam
+# first with per-category attribution through profiling.diff — while a
+# candidate whose provenance drifted on an UNdeclared key (dtype) must be
+# REFUSED, never ranked (the run_compare rule from ISSUE 14, applied
+# per-candidate). The TUNED.json emit/load round-trip and the XLA-flag ->
+# per-compile compiler_options bridge are asserted in the same run. A
+# Pallas-parity smoke leg then re-checks kernel<->plain forward AND backward
+# parity in interpret mode plus the one-time kernel_dispatch telemetry and
+# the shared scan-chain timing core.
+#
+# Stage 16 is the fleet-controller soak (ISSUE 16; docs/fault_tolerance.md
 # "Closed-loop recovery"): fleet_controller.py --soak --quick spawns a 3-run
 # digits fleet and injects one disease per run (SIGKILL mid-run, a FaultPlan
 # hang tripping the step watchdog, the slow_chip seam degrading one named
@@ -132,12 +148,12 @@
 # decision, touch nothing) and exit non-zero: the controller never acts
 # without budget.
 #
-# Stage 16 is the ROADMAP.md tier-1 command verbatim.
+# Stage 17 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/16: import health (pytest --collect-only) =="
+echo "== stage 1/17: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -146,7 +162,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/16: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/17: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -172,25 +188,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/16: chained-dispatch retrace guard =="
+echo "== stage 3/17: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/16: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/17: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/16: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/17: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/16: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/17: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -200,26 +216,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/16: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/17: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/16: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/17: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/16: elastic chaos soak (kill on N devices, resume on M) =="
+echo "== stage 9/17: elastic chaos soak (kill on N devices, resume on M) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
   echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
   echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
   exit 11
 fi
 
-echo "== stage 10/16: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 10/17: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -231,7 +247,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 11/16: data-wait gate (clean + injected-starvation self-test) =="
+echo "== stage 11/17: data-wait gate (clean + injected-starvation self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait; then
   echo "DATA-WAIT GATE FAILED — the input pipeline's steady-state data_wait"
   echo "fraction exceeds the PERF_BASELINE.json ceiling (ROADMAP item 5)"
@@ -245,7 +261,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait --inject-data-wait 
 fi
 echo "data-wait gate self-test OK: injected loader sleep correctly failed"
 
-echo "== stage 12/16: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
+echo "== stage 12/17: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   echo "RUN DOCTOR SELF-TEST FAILED — an injected bottleneck was misdiagnosed,"
   echo "the clean twin was not healthy, or the exported timeline broke the"
@@ -253,7 +269,7 @@ if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   exit 13
 fi
 
-echo "== stage 13/16: live-monitor self-test (heartbeat liveness + streaming doctor + alerts) =="
+echo "== stage 13/17: live-monitor self-test (heartbeat liveness + streaming doctor + alerts) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_monitor.py --self-test; then
   echo "RUN MONITOR SELF-TEST FAILED — the liveness contract broke: a hang did"
   echo "not read stale_heartbeat, a SIGKILL did not read dead, the healthy twin"
@@ -262,7 +278,7 @@ if ! JAX_PLATFORMS=cpu python scripts/run_monitor.py --self-test; then
   exit 15
 fi
 
-echo "== stage 14/16: run-comparison gate (twin-diff + injected attribution + bench history) =="
+echo "== stage 14/17: run-comparison gate (twin-diff + injected attribution + bench history) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_compare.py --self-test; then
   echo "RUN COMPARE SELF-TEST FAILED — identical twins did not diff clean, or"
   echo "an injected known-cause slowdown (3x conv / loader sleep / commit"
@@ -271,11 +287,30 @@ if ! JAX_PLATFORMS=cpu python scripts/run_compare.py --self-test; then
 fi
 if ! JAX_PLATFORMS=cpu python scripts/bench_history.py --self-test; then
   echo "BENCH HISTORY SELF-TEST FAILED — the committed r02->r05 flat streak"
-  echo "was not detected on the committed BENCH_r files (docs/profiling.md)"
+  echo "was not detected on the committed BENCH_r files, or a flat streak is"
+  echo "STILL live at the newest round (r06 must sit outside the band —"
+  echo "docs/profiling.md)"
   exit 14
 fi
 
-echo "== stage 15/16: fleet-controller soak (closed-loop recovery + zero-budget refusal) =="
+echo "== stage 15/17: autotune gate (injected-win ranking + provenance refusal) + pallas parity =="
+if ! JAX_PLATFORMS=cpu python scripts/autotune.py --self-test; then
+  echo "AUTOTUNE SELF-TEST FAILED — the injected known-win (3x de-tuned"
+  echo "baseline) was not ranked first with per-category attribution, a"
+  echo "provenance-drifted candidate was not refused, or the TUNED.json"
+  echo "round-trip broke (docs/performance.md 'Autotuning')"
+  exit 17
+fi
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_pallas.py tests/test_dispatch.py tests/test_autotune.py \
+    -q -m 'not slow' -p no:cacheprovider > /tmp/_pallas_parity.log 2>&1; then
+  echo "PALLAS PARITY SMOKE FAILED — kernel<->plain parity, dispatch telemetry,"
+  echo "or the shared timing core regressed (log: /tmp/_pallas_parity.log)"
+  tail -20 /tmp/_pallas_parity.log
+  exit 17
+fi
+tail -1 /tmp/_pallas_parity.log
+
+echo "== stage 16/17: fleet-controller soak (closed-loop recovery + zero-budget refusal) =="
 if ! JAX_PLATFORMS=cpu python scripts/fleet_controller.py --soak --quick; then
   echo "FLEET SOAK FAILED — the closed-loop controller did not restore the"
   echo "diseased fleet to healthy (restart / restart_excluding / A/B tune),"
@@ -291,7 +326,7 @@ if JAX_PLATFORMS=cpu python scripts/fleet_controller.py --soak --quick --max-res
 fi
 echo "fleet soak self-test OK: zero-budget controller refused without acting"
 
-echo "== stage 16/16: tier-1 test suite =="
+echo "== stage 17/17: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -204,6 +204,86 @@ def test_conv1x1_bn_act_diff_gradients():
             )
 
 
+def test_conv1x1_bn_act_gelu_epilogue_matches_reference():
+    """act="gelu" (the ConvNeXt expand-Dense epilogue, ISSUE 17) == tanh-
+    approx gelu((x @ w) * a + b) — the same approximation flax's nn.gelu
+    defaults to, so the fused path matches the plain Dense+gelu program."""
+    from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 5, 3, 24), jnp.float32)
+    w = jnp.asarray(rng.randn(24, 16) * 0.2, jnp.float32)
+    a = jnp.asarray(rng.rand(16) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(16), jnp.float32)
+    got = conv1x1_bn_act(x, w, a, b, act="gelu", interpret=True, block_rows=32)
+    ref = jax.nn.gelu((x.reshape(-1, 24) @ w) * a + b, approximate=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref.reshape(2, 5, 3, 16)), atol=1e-5
+    )
+
+
+def test_conv1x1_bn_act_diff_gelu_gradients():
+    """Backward parity for the gelu epilogue: the custom VJP's z-recompute +
+    jax.vjp gelu backward == autodiff of the plain reference, all operands."""
+    from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act_diff
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(48, 24), jnp.float32)
+    w = jnp.asarray(rng.randn(24, 16) * 0.2, jnp.float32)
+    a = jnp.asarray(rng.rand(16) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(16), jnp.float32)
+
+    def f(x, w, a, b):
+        return jnp.sum(
+            conv1x1_bn_act_diff(
+                x, w, a, b, act="gelu", interpret=True, block_rows=16
+            ) ** 2
+        )
+
+    def ref(x, w, a, b):
+        return jnp.sum(jax.nn.gelu((x @ w) * a + b, approximate=True) ** 2)
+
+    gp = jax.grad(f, argnums=(0, 1, 2, 3))(x, w, a, b)
+    gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, w, a, b)
+    for p, r, name in zip(gp, gr, ("x", "w", "scale", "bias"), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(r), atol=2e-4, err_msg=f"d{name} gelu"
+        )
+
+
+def test_chained_window_parity_fused_vs_plain():
+    """The chained-window program (the shape bench.py/autotune actually
+    time): a lax.scan whose carry feeds the next trip's input must agree
+    between the fused kernel and the plain path — values AND gradients
+    survive the scan's repeated VJP."""
+    from distributed_training_pytorch_tpu.ops.pallas import conv1x1_bn_act_diff
+
+    rng = np.random.RandomState(5)
+    x0 = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    w = jnp.asarray(rng.randn(16, 16) * 0.2, jnp.float32)
+    a = jnp.asarray(rng.rand(16) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(16), jnp.float32)
+
+    def chain(apply, x0, w):
+        def body(x, _):
+            y = apply(x, w)
+            return 0.5 * y + 0.5 * x, jnp.sum(y)
+        return jax.lax.scan(body, x0, None, length=4)
+
+    def fused(x, w):
+        return conv1x1_bn_act_diff(x, w, a, b, interpret=True, block_rows=16)
+
+    def plain(x, w):
+        return jnp.maximum((x @ w) * a + b, 0.0)
+
+    (cf, sf), (cp, sp) = chain(fused, x0, w), chain(plain, x0, w)
+    np.testing.assert_allclose(np.asarray(cf), np.asarray(cp), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(sf), np.asarray(sp), rtol=2e-6)
+    gf = jax.grad(lambda w: jnp.sum(chain(fused, x0, w)[0] ** 2))(w)
+    gp = jax.grad(lambda w: jnp.sum(chain(plain, x0, w)[0] ** 2))(w)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gp), atol=5e-4)
+
+
 def test_pallas_conv1x1_module_matches_nn_conv(monkeypatch):
     """models.resnet.PallasConv1x1 == nn.Conv 1x1 with the same kernel, for
     stride 1 and the strided-projection case."""

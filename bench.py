@@ -1147,7 +1147,111 @@ def _run_bench(dtype_name: str | None = None, include_peak: bool = True, ctx=Non
     )
 
 
+def _bench_serving():
+    """BENCH_SERVE=1 (ISSUE 18 satellite 5): the serving-path headline —
+    ``serve_p50_ms`` / ``serve_p99_ms`` / ``serve_qps_per_chip``, one JSON
+    line each, provenance-stamped like every training headline. Measures the
+    FULL request path (HTTP + admission + micro-batching + compiled forward)
+    of an LMTiny replica on a ``tp2`` mesh under saturating closed-loop
+    clients, so a regression in any serving layer moves the number.
+
+    Knobs: ``BENCH_SERVE_S`` (measure wall, default 5s), ``BENCH_SERVE_CLIENTS``
+    (concurrent closed-loop clients, default 8).
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    from distributed_training_pytorch_tpu.models import LMTiny
+    from distributed_training_pytorch_tpu.serving import (
+        InferEngine,
+        InferenceServer,
+        MicroBatcher,
+    )
+
+    seq_len, vocab = 16, 64
+    duration_s = float(os.environ.get("BENCH_SERVE_S", "5"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    # TP-sharded when the host has 2+ chips; single-chip hosts serve dp1.
+    mesh_spec = "tp2" if len(jax.devices()) >= 2 else "dp1"
+    devices = jax.devices()[: 2 if mesh_spec == "tp2" else 1]
+    mesh = mesh_lib.mesh_config_from_spec(mesh_spec).build(devices)
+    model = LMTiny(vocab_size=vocab)
+    params = model.init(jax.random.key(0), jnp.zeros((1, seq_len), jnp.int32))[
+        "params"
+    ]
+    engine = InferEngine(
+        lambda p, tokens: model.apply({"params": p}, tokens), mesh,
+        buckets=(1, 2, 4, 8),
+    )
+    engine.swap_params(params, version="bench")
+    engine.warmup(np.zeros((seq_len,), np.int32))
+
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_delay_s=0.004),
+        window_s=duration_s + 60.0,
+        input_dtype="int32",
+        process_index=0,
+    ).start()
+    stop = threading.Event()
+    counts = [0] * n_clients
+    try:
+        def client(i: int) -> None:
+            rng = np.random.default_rng(i)
+            url = f"http://127.0.0.1:{server.port}/predict"
+            while not stop.is_set():
+                row = rng.integers(0, vocab, size=(seq_len,)).tolist()
+                body = _json.dumps({"tenant": f"c{i}", "inputs": [row]}).encode()
+                req = urllib.request.Request(
+                    url, data=body, headers={"Content-Type": "application/json"}
+                )
+                with urllib.request.urlopen(req, timeout=30.0) as resp:
+                    resp.read()
+                counts[i] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        elapsed = time.monotonic() - t0
+        win = server.window.snapshot()
+        qps_per_chip = sum(counts) / elapsed / len(devices)
+    finally:
+        server.close()
+
+    provenance = provenance_fields(
+        mesh=mesh_spec, dtype="float32", chain_steps=1, batch=max(engine.buckets)
+    )
+    common = {
+        "model": "lm_tiny",
+        "clients": n_clients,
+        "requests": sum(counts),
+        "buckets": list(engine.buckets),
+        "provenance": provenance,
+    }
+    for metric, value, unit in (
+        ("serve_p50_ms", round(win["p50_ms"], 2), "ms"),
+        ("serve_p99_ms", round(win["p99_ms"], 2), "ms"),
+        ("serve_qps_per_chip", round(qps_per_chip, 2), "req/s/chip"),
+    ):
+        print(json.dumps({"metric": metric, "value": value, "unit": unit, **common}))
+
+
 def main():
+    # BENCH_SERVE=1: the serving-path headline instead of the training-step
+    # measurement — a separate program (forward-only, latency-bound), so the
+    # two benches never contaminate each other's allocator high-water marks.
+    if os.environ.get("BENCH_SERVE", "") not in ("", "0"):
+        _bench_serving()
+        return
     # TUNED=1 (ISSUE 17): adopt the committed TUNED.json winner's knobs as
     # DEFAULTS — chain_steps maps to BENCH_STEPS, pallas to BENCH_PALLAS,
     # and xla_flags installs into XLA_FLAGS when unset (tuned_defaults does

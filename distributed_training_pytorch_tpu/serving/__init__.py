@@ -1,0 +1,64 @@
+"""Serving subsystem: continuous-batching inference on the training machinery.
+
+ISSUE 18 — the first consumer shape in this repo that is not an epoch
+loop. Three layers, each reusing a proven training-side pattern instead
+of inventing a serving-only twin:
+
+* :mod:`.batcher` — pure-Python request admission: continuous
+  micro-batching with bucketized batch sizes, admit-until-bucket-deadline
+  flushing, per-tenant fair admission, and typed overload rejection.
+  No jax import; unit-testable without devices.
+* :mod:`.engine`  — :class:`~.engine.InferEngine`: forward-only compiled
+  executables with a per-bucket-shape cache and ``trace_counts``
+  accounting (the ``TrainEngine`` contract), params loaded from the
+  async saver's crash-consistent manifest (``restore_latest_valid`` /
+  ``best``) and hot-swapped by atomic reference flip.
+* :mod:`.server`  — :class:`~.server.InferenceServer`: the rank-0 stdlib
+  HTTP server (the PR 15 exporter pattern) exposing ``/predict``,
+  ``/status`` and ``/metrics`` (p50/p99 latency, QPS/chip), emitting the
+  serving event vocabulary (``serve_start`` / ``request_batch`` /
+  ``hot_swap`` / ``admission_reject``) into the same JSONL flight
+  recorder the fleet monitor and controller already read.
+
+Import neutrality: importing this package (or any submodule) has no
+side effects on the training path — no backend init, no global config
+writes; a trainer run with serving imported but unused is bit-exact
+with one that never imported it (test-enforced).
+"""
+
+from distributed_training_pytorch_tpu.serving.batcher import (  # noqa: F401
+    MicroBatch,
+    MicroBatcher,
+    OverloadRejected,
+    Request,
+    pick_bucket,
+)
+
+# The device-touching layers resolve lazily (PEP 562): the package import
+# stays jax-free (the neutrality contract above), but callers still write
+# ``from ...serving import InferEngine, InferenceServer``.
+_LAZY = {
+    "InferEngine": "distributed_training_pytorch_tpu.serving.engine",
+    "InferenceServer": "distributed_training_pytorch_tpu.serving.server",
+    "LatencyWindow": "distributed_training_pytorch_tpu.serving.server",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "InferEngine",
+    "InferenceServer",
+    "LatencyWindow",
+    "MicroBatch",
+    "MicroBatcher",
+    "OverloadRejected",
+    "Request",
+    "pick_bucket",
+]

@@ -1,0 +1,533 @@
+"""Rank-0 HTTP inference server with checkpoint hot-swap (ISSUE 18 c).
+
+The PR 15 exporter pattern, grown one route: a stdlib
+``ThreadingHTTPServer`` on daemon threads serving
+
+* ``POST /predict`` — admit a request into the continuous micro-batcher
+  (:mod:`.batcher`), block the handler thread until its batch completes,
+  answer with the outputs and the params version they were computed on.
+  A full tenant queue answers HTTP 429 with the typed overload facts —
+  never queues unboundedly, never hangs (the zero-capacity soak leg).
+* ``GET /status``  — one JSON snapshot: p50/p99 latency, QPS and
+  QPS/chip, params version, swap/reject/batch counters, SLO verdict.
+* ``GET /metrics`` — the same snapshot as Prometheus text
+  (``telemetry.exporter.prometheus_text``, ``tpu_serve_`` prefix).
+
+Hot-swap under load (the PR 5 snapshot->commit manifest, read side): a
+watcher thread polls the checkpoint directory; when the served name
+(``best`` preferred, newest-valid fallback) commits a new manifest, it
+restores ``params_only`` OFF the request path and installs the new tree
+via ``InferEngine.swap_params`` — one atomic reference flip. In-flight
+batches finish on the params they started with; no request ever stalls
+on a swap (docs/serving.md "Hot-swap state machine").
+
+Observability rides the existing flight recorder: the server claims an
+attempt id and emits ``serve_start`` / ``request_batch`` (a ~1 Hz
+summary pulse that doubles as the liveness heartbeat) / ``hot_swap`` /
+``admission_reject`` (debounced per tenant) into
+``<run_dir>/telemetry/events.jsonl`` — so ``RunMonitor``, the fleet
+table, and the fleet controller supervise a server exactly like a
+trainer (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from distributed_training_pytorch_tpu.serving.batcher import (
+    MicroBatcher,
+    OverloadRejected,
+)
+from distributed_training_pytorch_tpu.telemetry.events import (
+    EventLog,
+    _jsonable,
+    claim_attempt,
+    resolve_events_path,
+)
+from distributed_training_pytorch_tpu.telemetry.exporter import prometheus_text
+
+__all__ = ["InferenceServer", "LatencyWindow"]
+
+
+class LatencyWindow:
+    """Trailing-window latency/throughput accounting: completion times and
+    per-request latencies over the last ``window_s`` seconds. p50/p99 by
+    nearest-rank quantile on the live window — small (seconds of traffic),
+    so sorting per snapshot is cheap and exact."""
+
+    def __init__(self, window_s: float = 30.0, clock=time.monotonic):
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._done: list = []  # (t_done, latency_ms), trimmed on insert
+
+    def add(self, t_done: float, latency_ms: float) -> None:
+        with self._lock:
+            self._done.append((t_done, latency_ms))
+            cutoff = t_done - self.window_s
+            if self._done and self._done[0][0] < cutoff:
+                self._done = [d for d in self._done if d[0] >= cutoff]
+
+    def snapshot(self, now: "float | None" = None) -> dict:
+        now = self._clock() if now is None else now
+        cutoff = now - self.window_s
+        with self._lock:
+            live = [d for d in self._done if d[0] >= cutoff]
+        if not live:
+            return {"qps": 0.0, "p50_ms": None, "p99_ms": None, "window_n": 0}
+        lat = sorted(d[1] for d in live)
+        span = min(self.window_s, max(now - live[0][0], 1e-6))
+
+        def q(p: float) -> float:
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {
+            "qps": round(len(live) / span, 2),
+            "p50_ms": round(q(0.50), 3),
+            "p99_ms": round(q(0.99), 3),
+            "window_n": len(lat),
+        }
+
+
+class InferenceServer:
+    """One serving replica (see module doc).
+
+    ``engine`` is a params-loaded :class:`~.engine.InferEngine`;
+    ``manager``/``target_state`` (optional) arm the hot-swap watcher —
+    ``serve_name`` picks what it follows (default: ``"best"`` when that
+    name exists, else the newest valid checkpoint). ``slo_p99_ms`` arms
+    the SLO verdict surfaced on ``/status`` and the ``request_batch``
+    pulse (the monitor's server exit-code contract). ``port=0`` binds
+    ephemeral; read it back from :attr:`port`.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        batcher: "MicroBatcher | None" = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        run_dir: "str | None" = None,
+        manager=None,
+        target_state=None,
+        serve_name: "str | None" = None,
+        swap_poll_s: float = 0.5,
+        slo_p99_ms: "float | None" = None,
+        window_s: float = 30.0,
+        pulse_every_s: float = 1.0,
+        request_timeout_s: float = 30.0,
+        input_dtype: str = "float32",
+        process_index: "int | None" = None,
+        clock=time.monotonic,
+        log=print,
+    ):
+        self.engine = engine
+        self.batcher = batcher if batcher is not None else MicroBatcher(
+            buckets=engine.buckets
+        )
+        self._requested_port = int(port)
+        self.host = host
+        self.run_dir = run_dir
+        self.manager = manager
+        self.target_state = target_state
+        self.serve_name = serve_name
+        self.swap_poll_s = float(swap_poll_s)
+        self.slo_p99_ms = slo_p99_ms
+        self.pulse_every_s = float(pulse_every_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.input_dtype = np.dtype(input_dtype)
+        self._clock = clock
+        self._log = log
+        self.window = LatencyWindow(window_s, clock=clock)
+        self.port: "int | None" = None
+        self.enabled = False
+        self.attempt: "int | None" = None
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._server: "ThreadingHTTPServer | None" = None
+        self._started = 0.0
+        self.requests_total = 0
+        self._swap_identity = None
+        self._reject_debounce: dict = {}  # tenant -> (last_emit_t, count_since)
+        self._pulse_state = {"t": 0.0, "requests": 0, "batches": 0}
+        self._lock = threading.Lock()
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.process_index = int(process_index)
+        self.events = None
+        if run_dir is not None and self.process_index == 0:
+            self.events = EventLog(resolve_events_path(run_dir), process_index=0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        """Bind, start dispatch + swap + HTTP threads, emit ``serve_start``.
+        Only rank 0 serves (the exporter/EventLog ownership rule); other
+        ranks no-op with ``enabled=False``."""
+        if self.process_index != 0:
+            return self
+        if self.run_dir is not None:
+            self.attempt = claim_attempt(self.run_dir)
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: D102 — silence stdlib logging
+                pass
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+                route = self.path.split("?", 1)[0].rstrip("/") or "/status"
+                snapshot = server.snapshot()
+                if route in ("/status", "/"):
+                    self._respond(
+                        200, "application/json", json.dumps(_jsonable(snapshot)) + "\n"
+                    )
+                elif route == "/metrics":
+                    self._respond(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        prometheus_text(
+                            {k: v for k, v in snapshot.items() if v is not None},
+                            prefix="tpu_serve",
+                        ),
+                    )
+                else:
+                    self._respond(404, "text/plain", "try /predict, /status or /metrics\n")
+
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+                route = self.path.split("?", 1)[0].rstrip("/")
+                if route != "/predict":
+                    self._respond(404, "text/plain", "POST /predict only\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    tenant = str(body.get("tenant", "default"))
+                    inputs = np.asarray(body["inputs"], dtype=server.input_dtype)
+                except (KeyError, TypeError, ValueError) as e:
+                    self._respond(
+                        400, "application/json",
+                        json.dumps({"error": "bad_request", "detail": str(e)}) + "\n",
+                    )
+                    return
+                code, payload = server.handle_predict(tenant, inputs)
+                self._respond(code, "application/json", payload)
+
+            def _respond(self, code: int, ctype: str, body: str):
+                try:
+                    payload = body.encode("utf-8")
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except OSError:
+                    pass  # client went away mid-response: its problem
+
+        try:
+            self._server = ThreadingHTTPServer(
+                (self.host, self._requested_port), _Handler
+            )
+        except OSError as e:
+            # The exporter's taken-port policy: serving disabled with one
+            # warning — an observability/port clash must be diagnosable,
+            # not a crash loop.
+            self._log(
+                f"inference server disabled — could not bind "
+                f"{self.host}:{self._requested_port} ({e})"
+            )
+            return self
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self._started = self._clock()
+        self._pulse_state["t"] = self._started
+        for name, fn in (
+            ("serve-dispatch", self._dispatch_loop),
+            ("serve-http", self._server.serve_forever),
+        ):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.manager is not None and self.target_state is not None:
+            t = threading.Thread(
+                target=self._swap_loop, name="serve-hotswap", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        self.enabled = True
+        if self.events is not None:
+            self.events.emit(
+                "serve_start",
+                attempt=self.attempt,
+                port=self.port,
+                buckets=list(self.engine.buckets),
+                max_delay_s=self.batcher.max_delay_s,
+                max_queue_depth=self.batcher.max_queue_depth,
+                slo_p99_ms=self.slo_p99_ms,
+                params_version=self.engine.params_version,
+                mesh_axes={str(k): int(v) for k, v in self.engine.mesh.shape.items()},
+            )
+        return self
+
+    def close(self) -> None:
+        """Graceful stop: drain the queue, stop threads, emit ``run_end``
+        (the monitor's finished marker). Idempotent."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self.events is not None and self.enabled:
+            self.events.emit("run_end", attempt=self.attempt, kind="serve")
+            self.events.close()
+        self.enabled = False
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- request path ------------------------------------------------------
+
+    def handle_predict(self, tenant: str, inputs: np.ndarray) -> "tuple[int, str]":
+        """Admit -> wait -> answer. Returns (HTTP code, JSON body). The
+        response body is a pure function of (inputs, served params): no
+        timestamps or latencies in it, so equal params produce equal bytes
+        across a hot-swap boundary (the soak's bit-identity leg)."""
+        if inputs.ndim == 0 or inputs.shape[0] == 0:
+            return 400, json.dumps({"error": "bad_request", "detail": "empty inputs"}) + "\n"
+        try:
+            # One request row per payload: a multi-row POST admits each row
+            # separately so the batcher's fairness applies per row.
+            reqs = [self.batcher.submit(tenant, row) for row in inputs]
+        except OverloadRejected as e:
+            self._note_reject(e)
+            return 429, json.dumps(
+                {
+                    "error": "overload",
+                    "tenant": e.tenant,
+                    "depth": e.depth,
+                    "bound": e.bound,
+                }
+            ) + "\n"
+        deadline = self._clock() + self.request_timeout_s
+        for req in reqs:
+            if not req.wait(max(0.0, deadline - self._clock())):
+                return 504, json.dumps({"error": "timeout"}) + "\n"
+            if req.error is not None:
+                return 500, json.dumps({"error": "inference_failed", "detail": req.error}) + "\n"
+        return 200, json.dumps(
+            {
+                "outputs": [np.asarray(r.result).tolist() for r in reqs],
+                "params_version": reqs[-1].params_version,
+            }
+        ) + "\n"
+
+    def _note_reject(self, e: OverloadRejected) -> None:
+        """``admission_reject`` events, debounced to one per tenant per
+        second (a saturating tenant must not flood its own flight
+        recorder); the per-tenant counter in /status stays exact."""
+        if self.events is None:
+            return
+        now = self._clock()
+        last_t, pent = self._reject_debounce.get(e.tenant, (0.0, 0))
+        pent += 1
+        if now - last_t >= 1.0:
+            self.events.emit(
+                "admission_reject",
+                attempt=self.attempt,
+                tenant=e.tenant,
+                depth=e.depth,
+                bound=e.bound,
+                rejects=pent,
+                rejected_total=int(sum(self.batcher.rejected.values())),
+            )
+            self._reject_debounce[e.tenant] = (now, 0)
+        else:
+            self._reject_debounce[e.tenant] = (last_t, pent)
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.batcher.next_batch()
+            if batch is None:
+                self._maybe_pulse()
+                # Sleep to the earliest of: the oldest request's flush
+                # deadline, the next pulse, or a 2 ms poll tick.
+                now = self._clock()
+                dl = self.batcher.next_deadline()
+                bound = 0.002 if dl is None else max(0.0, min(dl - now, 0.002))
+                self._stop.wait(bound)
+                continue
+            payloads = np.stack(batch.payloads())
+            t_out = None
+            try:
+                out, version = self.engine.predict(payloads)
+            except Exception as e:  # noqa: BLE001 — answered as 500s, server survives
+                for req in batch.requests:
+                    req.error = f"{type(e).__name__}: {e}"
+                    req.done.set()
+                self._log(f"inference batch failed: {type(e).__name__}: {e}")
+                continue
+            t_out = self._clock()
+            for i, req in enumerate(batch.requests):
+                req.result = out[i]
+                req.params_version = version
+                req.completed = t_out
+                self.window.add(t_out, (t_out - req.arrival) * 1e3)
+                req.done.set()
+            with self._lock:
+                self.requests_total += len(batch.requests)
+                self._pulse_state["requests"] += len(batch.requests)
+                self._pulse_state["batches"] += 1
+            self._maybe_pulse()
+        # Drain on shutdown: flush whatever is queued so no handler thread
+        # is left blocked on a request that will never run.
+        batch = self.batcher.next_batch(drain=True)
+        while batch is not None:
+            for req in batch.requests:
+                req.error = "server shutting down"
+                req.done.set()
+            batch = self.batcher.next_batch(drain=True)
+
+    def _maybe_pulse(self) -> None:
+        """The ~1 Hz ``request_batch`` summary record: throughput/latency
+        since the last pulse plus the trailing-window quantiles. Emitted
+        even when idle — it doubles as the server's liveness heartbeat for
+        the monitor (an idle healthy replica must not read as dead)."""
+        if self.events is None:
+            return
+        now = self._clock()
+        with self._lock:
+            if now - self._pulse_state["t"] < self.pulse_every_s:
+                return
+            since = now - self._pulse_state["t"]
+            requests, batches = (
+                self._pulse_state["requests"],
+                self._pulse_state["batches"],
+            )
+            self._pulse_state.update(t=now, requests=0, batches=0)
+        win = self.window.snapshot(now)
+        self.events.emit(
+            "request_batch",
+            attempt=self.attempt,
+            requests=requests,
+            batches=batches,
+            interval_s=round(since, 3),
+            qps=win["qps"],
+            p50_ms=win["p50_ms"],
+            p99_ms=win["p99_ms"],
+            slo_p99_ms=self.slo_p99_ms,
+            slo_ok=self._slo_ok(win),
+            params_version=self.engine.params_version,
+            rejected_total=int(sum(self.batcher.rejected.values())),
+        )
+
+    def _slo_ok(self, win: dict) -> "bool | None":
+        if self.slo_p99_ms is None:
+            return None
+        if win["p99_ms"] is None:
+            return True  # no traffic in the window: nothing breached
+        return bool(win["p99_ms"] <= self.slo_p99_ms)
+
+    # -- hot-swap watcher --------------------------------------------------
+
+    def _swap_candidate(self) -> "tuple[str, float] | None":
+        """(name, manifest mtime) of the checkpoint this replica should be
+        serving: the pinned ``serve_name`` when set, else ``best`` when it
+        exists, else the newest valid. The mtime is the commit identity —
+        the atomic rename that publishes a checkpoint also refreshes it."""
+        from distributed_training_pytorch_tpu.checkpoint.manager import MANIFEST_NAME
+
+        name = self.serve_name
+        if name is None:
+            name = "best" if self.manager.exists("best") else (
+                self.manager.latest_valid_name()
+            )
+        if name is None or not self.manager.exists(name):
+            return None
+        try:
+            mtime = os.path.getmtime(os.path.join(self.manager.path(name), MANIFEST_NAME))
+        except OSError:
+            return None
+        return (name, mtime)
+
+    def _swap_loop(self) -> None:
+        while not self._stop.wait(self.swap_poll_s):
+            try:
+                cand = self._swap_candidate()
+            except Exception:  # noqa: BLE001 — a racing commit retries next poll
+                continue
+            if cand is None or cand == self._swap_identity:
+                continue
+            before = self.engine.params_version
+            t0 = self._clock()
+            try:
+                version = self.engine.restore_params(
+                    self.manager, self.target_state, name=cand[0]
+                )
+            except Exception as e:  # noqa: BLE001 — serve the old params; retry next poll
+                self._log(f"hot-swap restore failed (serving old params): {e}")
+                continue
+            with self._lock:
+                self._swap_identity = cand
+            if self.events is not None:
+                self.events.emit(
+                    "hot_swap",
+                    attempt=self.attempt,
+                    checkpoint=cand[0],
+                    from_version=before,
+                    to_version=version,
+                    swap_ms=round((self._clock() - t0) * 1e3, 2),
+                    swaps=self.engine.swap_count,
+                    pending_requests=self.batcher.pending(),
+                )
+
+    # -- status ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        win = self.window.snapshot(now)
+        stats = self.batcher.stats()
+        import jax
+
+        n_chips = jax.device_count()
+        return {
+            "kind": "server",
+            "port": self.port,
+            "attempt": self.attempt,
+            "uptime_s": round(now - self._started, 1) if self._started else 0.0,
+            "params_version": self.engine.params_version,
+            "swaps": self.engine.swap_count,
+            "requests_total": self.requests_total,
+            "pending": stats["pending"],
+            "rejected": stats["rejected"],
+            "rejected_total": stats["rejected_total"],
+            "batches": stats["batches"],
+            "pad_frac": round(stats["pad_frac"], 4),
+            "flushes": stats["flushes"],
+            "qps": win["qps"],
+            "qps_per_chip": round(win["qps"] / n_chips, 3),
+            "p50_ms": win["p50_ms"],
+            "p99_ms": win["p99_ms"],
+            "slo_p99_ms": self.slo_p99_ms,
+            "slo_ok": self._slo_ok(win),
+            "trace_counts": dict(self.engine.trace_counts),
+            "buckets": list(self.engine.buckets),
+        }

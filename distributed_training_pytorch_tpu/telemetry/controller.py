@@ -76,6 +76,10 @@ ACTION_KINDS = (
     "revert",
     "give_up",
     "refuse",
+    # Mixed-fleet (ISSUE 18 satellite 1): when restart_excluding frees a
+    # chip from a trainer's mesh, the fleet controller offers it to a
+    # serving replica — advisory (recorded, audited), never a respawn.
+    "offer_chip",
 )
 
 # Actions that respawn the trainer subprocess (and therefore consume one

@@ -66,8 +66,20 @@ formerly-silent below-``FLASH_MIN_SEQ_LEN`` fall-through, and ``seq_len``
 where shape-dependent), deduplicated to one record per distinct decision
 per process and forwarded through the sink the Trainer installs for the
 run — so a "tuned" run that quietly lost its kernels is visible to the
-doctor) — as one JSON object per line, machine-readable and
-append-only. Since schema 2 every record also carries ``chips`` (this
+doctor), and the serving layer's records (ISSUE 18, emitted by
+``serving/server.py`` into the SAME per-run-dir flight recorder the
+monitor/controller already read: ``serve_start`` — one per server
+attempt (``port``, ``buckets``, admission bounds, ``slo_p99_ms``,
+``params_version``, ``mesh_axes``); ``request_batch`` — the ~1 Hz
+serving summary pulse doubling as the server's liveness heartbeat
+(``requests``/``batches`` since the last pulse, trailing-window ``qps``,
+``p50_ms``/``p99_ms``, ``slo_ok``, ``params_version``); ``hot_swap`` —
+one checkpoint hot-swap under load (``checkpoint`` name,
+``from_version``/``to_version``, ``swap_ms``, ``pending_requests``);
+``admission_reject`` — a typed overload rejection, debounced to one
+record per tenant per second (``tenant``, ``depth`` vs ``bound``,
+``rejects`` since the last record)) — as one JSON object per line,
+machine-readable and append-only. Since schema 2 every record also carries ``chips`` (this
 process's local device ids) and ``schema`` (:data:`SCHEMA_VERSION`), so
 per-chip attribution survives elastic topology changes and consumers can
 detect vocabularies they predate. Since schema 4, ``run_start`` and
@@ -139,8 +151,13 @@ __all__ = [
 #   5 — the kernel-policy vocabulary (ISSUE 17): ``kernel_dispatch``
 #       (one ops/dispatch.py Pallas-vs-plain resolution: ``model``,
 #       ``op``, ``path``, ``reason``, optional ``seq_len`` — deduplicated
-#       per distinct decision per process).
-SCHEMA_VERSION = 5
+#       per distinct decision per process);
+#   6 — the serving vocabulary (ISSUE 18): ``serve_start``,
+#       ``request_batch`` (the server's liveness pulse), ``hot_swap``,
+#       ``admission_reject`` (serving/server.py), and ``offer_chip``
+#       joins the ``controller_action`` action vocabulary (a mixed-fleet
+#       controller offering a freed chip to a serving replica).
+SCHEMA_VERSION = 6
 
 
 def _jsonable(value: Any) -> Any:

@@ -85,6 +85,12 @@ _PROGRESS_KINDS = (
     "checkpoint_save",
     "preemption",
     "run_end",
+    # The serving vocabulary (ISSUE 18): the server's dispatch loop emits
+    # request_batch as a ~1 Hz summary pulse even when idle — it is the
+    # server's liveness heartbeat, exactly as `window` is the trainer's.
+    "serve_start",
+    "request_batch",
+    "hot_swap",
 )
 
 # Verdicts alerted on transition (score crossing 1.0). data_bound /
@@ -141,8 +147,9 @@ class MonitorStatus:
     """One poll's answer: liveness + the doctor's online diagnosis."""
 
     run_dir: str
-    status: str  # waiting | training | stale_heartbeat | dead | finished
-    verdict: str  # liveness kind when stale/dead, else the doctor's top verdict
+    status: str  # waiting | training | serving | stale_heartbeat | dead | finished
+    verdict: str  # liveness kind when stale/dead; doctor's top verdict for
+    # trainers; healthy|slo_breach for servers (ISSUE 18 satellite 2)
     diagnosis: "doctor_lib.Diagnosis | None"
     steady_fractions: dict
     last_event_age_s: float | None
@@ -151,6 +158,8 @@ class MonitorStatus:
     alerts: list  # rules that fired THIS poll (debounced)
     active_alerts: tuple  # every rule currently over its line
     attempt: int | None = None  # restart generation the verdict describes
+    kind: str = "train"  # "train" | "serve" (a serve_start record flips it)
+    serve: dict = dataclasses.field(default_factory=dict)  # last request_batch pulse
 
     @property
     def exit_code(self) -> int:
@@ -182,7 +191,10 @@ class MonitorStatus:
             "alerts": self.alerts,
             "active_alerts": list(self.active_alerts),
             "exit_code": self.exit_code,
+            "kind": self.kind,
         }
+        if self.serve:
+            out["serve"] = self.serve
         if self.diagnosis is not None:
             out["diagnosis"] = self.diagnosis.to_dict()
         return out
@@ -194,11 +206,18 @@ class MonitorStatus:
             ages.append(f"last event {self.last_event_age_s:.1f}s ago")
         if self.progress_age_s is not None:
             ages.append(f"progress {self.progress_age_s:.1f}s ago")
-        hl = ", ".join(
-            f"{k} {self.headline[k]}"
-            for k in ("epoch", "step_in_epoch", "units", "step_ms")
-            if self.headline.get(k) is not None
-        )
+        if self.kind == "serve":
+            hl = ", ".join(
+                f"{k} {self.serve[k]}"
+                for k in ("qps", "p50_ms", "p99_ms", "params_version")
+                if self.serve.get(k) is not None
+            )
+        else:
+            hl = ", ".join(
+                f"{k} {self.headline[k]}"
+                for k in ("epoch", "step_in_epoch", "units", "step_ms")
+                if self.headline.get(k) is not None
+            )
         lines = [
             f"{self.run_dir}: {self.status.upper()} [{self.verdict}]"
             + (f" ({'; '.join(ages)})" if ages else ""),
@@ -221,24 +240,30 @@ class MonitorStatus:
         return "\n".join(lines)
 
     def fleet_row(self) -> dict:
-        """The multi-run table projection (stable key order)."""
+        """The multi-run table projection (stable key order). Trainer and
+        server rows share one schema (ISSUE 18 satellite 2): server rows
+        fill qps/p99 and blank the trainer-only columns; trainer rows the
+        inverse — so a mixed fleet renders side by side in one table."""
         fr = self.steady_fractions
         age = self.last_event_age_s
+        serving = self.kind == "serve"
+
+        def _num(v, fmt="{:.1f}"):
+            return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
         return {
             "run": os.path.basename(os.path.normpath(self.run_dir)) or self.run_dir,
             "status": self.status,
             "verdict": self.verdict,
             "att": self.attempt if self.attempt is not None else "-",
-            "epoch": self.headline.get("epoch", "-"),
-            "step": self.headline.get("step_in_epoch", "-"),
-            "step_ms": (
-                f"{self.headline['step_ms']:.1f}"
-                if isinstance(self.headline.get("step_ms"), (int, float))
-                else "-"
-            ),
-            "good%": f"{100 * fr.get('productive_step', 0.0):.0f}",
-            "data%": f"{100 * fr.get('data_wait', 0.0):.0f}",
-            "ckpt%": f"{100 * fr.get('checkpoint', 0.0):.0f}",
+            "epoch": "-" if serving else self.headline.get("epoch", "-"),
+            "step": "-" if serving else self.headline.get("step_in_epoch", "-"),
+            "step_ms": "-" if serving else _num(self.headline.get("step_ms")),
+            "qps": _num(self.serve.get("qps"), "{:.2f}") if serving else "-",
+            "p99": _num(self.serve.get("p99_ms")) if serving else "-",
+            "good%": "-" if serving else f"{100 * fr.get('productive_step', 0.0):.0f}",
+            "data%": "-" if serving else f"{100 * fr.get('data_wait', 0.0):.0f}",
+            "ckpt%": "-" if serving else f"{100 * fr.get('checkpoint', 0.0):.0f}",
             "age_s": f"{age:.1f}" if age is not None else "-",
             "alerts": ",".join(self.active_alerts) or "-",
         }
@@ -301,6 +326,8 @@ class RunMonitor:
         self._active: dict[str, bool] = {}  # rule -> currently-over-the-line
         self.headline: dict = {}
         self._attempt: int | None = None  # last attempt id seen in-band
+        self._kind = "train"  # flips to "serve" on a serve_start record
+        self._serve: dict = {}  # last request_batch pulse's summary fields
         # Cumulative-goodput snapshot at the newest attempt's start: goodput
         # counters ride checkpoint meta across restarts (trainer resume
         # path), so the raw cumulative fractions would keep indicting a
@@ -349,6 +376,26 @@ class RunMonitor:
                 self._run_ended = False  # a resumed attempt re-opens the run
             elif kind == "run_end":
                 self._run_ended = True
+            elif kind == "serve_start":
+                # This run dir belongs to an inference server (ISSUE 18):
+                # liveness keys off request_batch pulses, verdicts off the
+                # pulse's SLO flag rather than goodput fractions.
+                self._kind = "serve"
+                self._run_ended = False
+            elif kind == "request_batch":
+                for key in (
+                    "qps",
+                    "p50_ms",
+                    "p99_ms",
+                    "slo_ok",
+                    "slo_p99_ms",
+                    "params_version",
+                    "rejected_total",
+                ):
+                    if key in rec:
+                        self._serve[key] = rec[key]
+            elif kind == "hot_swap" and rec.get("to_version") is not None:
+                self._serve["params_version"] = rec["to_version"]
             for key in ("epoch", "step_in_epoch"):
                 if rec.get(key) is not None:
                     self.headline[key] = rec[key]
@@ -470,6 +517,15 @@ class RunMonitor:
                 threshold=1,
                 message=f"{n} {kind} anomaly record(s) in the log",
             )
+        if self._kind == "serve":
+            p99 = self._serve.get("p99_ms")
+            rule(
+                "slo_breach",
+                self._serve.get("slo_ok") is False,
+                value=None if not isinstance(p99, (int, float)) else round(p99, 1),
+                threshold=self._serve.get("slo_p99_ms"),
+                message="server p99 latency over its SLO (last request_batch pulse)",
+            )
         scores = {v.kind: v for v in (diagnosis.verdicts if diagnosis else [])}
         for kind in _VERDICT_RULES:
             v = scores.get(kind)
@@ -515,10 +571,21 @@ class RunMonitor:
             for rec in self._follower.poll(final=True):
                 self._ingest(rec)
         sig = self._scoped_signals()
-        diagnosis = doctor_lib.diagnose(sig) if self._seen_any else None
+        if self._kind == "serve":
+            # A server has no goodput buckets or step cadence: the doctor's
+            # training heuristics on those empty signals would read a
+            # perfectly healthy server as diseased. Its verdict surface is
+            # liveness + the SLO flag its request_batch pulse carries.
+            diagnosis = None
+            if status == "training":
+                status = "serving"
+        else:
+            diagnosis = doctor_lib.diagnose(sig) if self._seen_any else None
         fractions = doctor_lib.steady_fractions(sig.goodput_seconds or {})
         if status in ("stale_heartbeat", "dead"):
             verdict = status
+        elif self._kind == "serve":
+            verdict = "slo_breach" if self._serve.get("slo_ok") is False else "healthy"
         elif diagnosis is not None:
             verdict = diagnosis.verdict
         else:
@@ -541,4 +608,6 @@ class RunMonitor:
             alerts=alerts,
             active_alerts=tuple(k for k, on in self._active.items() if on),
             attempt=self._attempt,
+            kind=self._kind,
+            serve=dict(self._serve),
         )

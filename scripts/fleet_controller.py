@@ -261,6 +261,7 @@ class RunSpec:
 
     name: str
     run_dir: str
+    kind: str = "train"  # "train" | "serve" — a mixed fleet (ISSUE 18)
     cmd: list | None = None
     adopt: bool = False  # no spawn at start; supervise whatever writes the log
     final: str = ""
@@ -396,6 +397,7 @@ class FleetController:
             self._replan_spec(spec, action)
             self._stop(run, graceful=True)
             self._spawn(run)
+            self._offer_freed_chip(run, action, status)
         elif action.kind in ("tune", "revert") and can_spawn:
             spec.knobs[action.params["knob"]] = action.params["to"]
             self._stop(run, graceful=True)
@@ -417,6 +419,56 @@ class FleetController:
             max_restarts=self.config.max_restarts,
             **action.event_fields(),
         )
+
+    def _offer_freed_chip(self, run: SupervisedRun, action, status) -> None:
+        """Mixed-fleet accounting (ISSUE 18 satellite 1): a chip a trainer's
+        ``restart_excluding`` just dropped from its mesh is not returned to
+        the scheduler — it is OFFERED to a serving replica in the same
+        fleet, as one advisory ``offer_chip`` controller_action per serving
+        run. A straggler chip too slow for a lockstep collective is often
+        fine for latency-bound inference (no per-step barrier to hold
+        hostage); the offer record carries that provenance so the operator
+        (or a capacity layer) can accept or decline with the evidence in
+        hand. Advisory only: the controller never respawns a healthy
+        server."""
+        from distributed_training_pytorch_tpu.telemetry.controller import Action
+
+        chip = action.params.get("exclude_chip")
+        if chip is None:
+            return
+        servers = [
+            r for r in self.runs.values()
+            if r.spec.kind == "serve" and r.spec.name != run.spec.name
+        ]
+        for srv in servers:
+            offer = Action(
+                kind="offer_chip",
+                reason=action.reason,
+                message=(
+                    f"chip {chip} freed from {run.spec.name}'s mesh by "
+                    f"restart_excluding; offered to serving replica "
+                    f"{srv.spec.name}"
+                ),
+                params={
+                    "chip": int(chip),
+                    "from_run": run.spec.name,
+                    "to_run": srv.spec.name,
+                },
+                evidence=list(action.evidence),
+            )
+            srv.actions.append(offer)
+            st = srv.last_status
+            self.events.emit(
+                "controller_action",
+                run=srv.spec.name,
+                run_dir=srv.spec.run_dir,
+                attempt=st.attempt if st is not None else None,
+                status=st.status if st is not None else "unknown",
+                verdict=st.verdict if st is not None else "unknown",
+                restarts_used=srv.policy.restarts_used,
+                max_restarts=self.config.max_restarts,
+                **offer.event_fields(),
+            )
 
     def _replan_spec(self, spec: RunSpec, action) -> None:
         """Fold the policy's exclusion into the spawn spec through the

@@ -9,6 +9,14 @@ still arrive but no execution unit completes) / ``dead`` (the log itself
 went silent) / ``finished``. One directory renders the detailed view;
 several render a fleet table, refreshed every ``--interval`` seconds.
 
+Inference servers (ISSUE 18, ``serving/server.py``) are first-class fleet
+members: a run dir whose log opens with ``serve_start`` reads status
+``serving``, its liveness keys off the server's ~1 Hz ``request_batch``
+pulse, and its fleet row fills the ``qps``/``p99`` columns that trainer
+rows blank (trainer-only columns blank in turn on server rows). A pulse
+reporting ``slo_ok: false`` turns the verdict to ``slo_breach`` — which
+``--once`` exits 1 on, the same CI contract as a degraded trainer.
+
 Usage::
 
     python scripts/run_monitor.py RUN_DIR             # follow one run
@@ -76,7 +84,7 @@ SCRIPT = os.path.abspath(__file__)
 
 _FLEET_COLUMNS = (
     "run", "status", "verdict", "att", "epoch", "step", "step_ms",
-    "good%", "data%", "ckpt%", "age_s", "alerts",
+    "qps", "p99", "good%", "data%", "ckpt%", "age_s", "alerts",
 )
 
 

@@ -1,0 +1,837 @@
+#!/usr/bin/env python
+"""Serving soak (ISSUE 18 tentpole d): continuous-batching inference under
+open-loop traffic, on the SAME machinery the trainer uses (mesh, shardings,
+checkpoint manifests, flight recorder).
+
+Four legs, all required, each with its own printed verdict line:
+
+1. **SLO** — seeded Poisson + bursty-tenant open-loop traffic against a
+   TP-sharded ``TransformerLM`` (LMTiny on a ``tp2`` mesh) and a replicated
+   vision model (ViTTiny on ``dp8``). Asserts: every request answered 200,
+   outputs match a direct un-served ``apply`` call, the trailing-window p99
+   meets the SLO, and steady-state traffic re-traces nothing (the
+   TrainEngine retrace-guard contract, applied to serving).
+2. **Hot-swap bit-identity** — a real :class:`CheckpointManager` commits
+   checkpoints while traffic runs. Re-committing identical params must
+   produce byte-identical ``/predict`` bodies across the swap boundary; a
+   new checkpoint at a higher epoch must change them. No request may fail
+   during any swap (the atomic reference flip never stalls the queue).
+3. **Failover** — a serving replica runs as a subprocess supervised by the
+   fleet controller (``RunSpec(kind="serve")``). SIGKILL it mid-service:
+   the controller's dead-process rule must respawn it, and the respawned
+   replica (same seed, same params) must answer byte-identically.
+4. **Zero capacity** — a server whose batcher admits nothing must REFUSE
+   (typed 429 within a bounded wall) — never hang the client.
+
+Open-loop means arrivals do not wait for completions: a slow server meets
+a growing queue, exactly like production. ``--quick`` shortens the traffic
+windows for CI; the assertions are identical.
+
+Exit 0 = every leg passed. Any failure prints ``serving_soak: FAIL`` lines
+and exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_pytorch_tpu import compat  # noqa: E402
+
+
+class SoakFailure(AssertionError):
+    """One leg's assertion, carrying the leg name for the verdict line."""
+
+
+def _check(cond: bool, leg: str, msg: str) -> None:
+    if not cond:
+        raise SoakFailure(f"[{leg}] {msg}")
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers (stdlib only — the soak must not dress up the client side)
+# ---------------------------------------------------------------------------
+
+
+def _post(port: int, payload: dict, timeout: float = 30.0) -> "tuple[int, bytes]":
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get_json(port: int, route: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{route}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_serving(port: int, row, *, timeout: float = 90.0) -> bytes:
+    """Poll /predict until the replica answers 200, return the body."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            code, body = _post(port, {"tenant": "probe", "inputs": [row]}, timeout=10.0)
+            if code == 200:
+                return body
+            last = (code, body[:200])
+        except (OSError, urllib.error.URLError) as e:
+            last = repr(e)
+        time.sleep(0.25)
+    raise SoakFailure(f"[failover] replica on :{port} never served (last: {last})")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# Open-loop traffic: seeded Poisson arrivals + a bursty tenant
+# ---------------------------------------------------------------------------
+
+
+def open_loop_traffic(
+    port: int,
+    make_row,
+    *,
+    seed: int,
+    duration_s: float,
+    rate_hz: float,
+    burst_every_s: float,
+    burst_n: int,
+):
+    """Fire requests open-loop: exponential inter-arrival gaps for tenant
+    ``web`` (Poisson process) plus tenant ``burst`` dumping ``burst_n``
+    requests at once every ``burst_every_s`` — the fairness stressor. Each
+    request runs on its own thread (arrivals never wait for completions).
+    Returns (results, errors): results are (tenant, code, body, latency_ms).
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    results: list = []
+    errors: list = []
+    threads: list = []
+
+    def fire(tenant: str, row) -> None:
+        t0 = time.monotonic()
+        try:
+            code, body = _post(port, {"tenant": tenant, "inputs": [row]})
+            results.append((tenant, code, body, (time.monotonic() - t0) * 1e3))
+        except Exception as e:  # noqa: BLE001 — client-side transport failure
+            errors.append((tenant, repr(e)))
+
+    t_end = time.monotonic() + duration_s
+    next_burst = time.monotonic() + burst_every_s
+    while time.monotonic() < t_end:
+        gap = float(rng.exponential(1.0 / rate_hz))
+        time.sleep(min(gap, max(0.0, t_end - time.monotonic())))
+        th = threading.Thread(target=fire, args=("web", make_row(rng)), daemon=True)
+        th.start()
+        threads.append(th)
+        if time.monotonic() >= next_burst:
+            next_burst += burst_every_s
+            for _ in range(burst_n):
+                th = threading.Thread(
+                    target=fire, args=("burst", make_row(rng)), daemon=True
+                )
+                th.start()
+                threads.append(th)
+    for th in threads:
+        th.join(timeout=60.0)
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: SLO under Poisson + burst, LM on tp2 and vision on dp8
+# ---------------------------------------------------------------------------
+
+SEQ_LEN = 16
+LM_VOCAB = 64
+
+
+def _lm_engine(mesh, seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_pytorch_tpu.models import LMTiny
+    from distributed_training_pytorch_tpu.serving import InferEngine
+
+    model = LMTiny(vocab_size=LM_VOCAB)
+    params = model.init(
+        jax.random.key(seed), jnp.zeros((1, SEQ_LEN), jnp.int32)
+    )["params"]
+
+    def apply_fn(p, tokens):
+        return model.apply({"params": p}, tokens)
+
+    engine = InferEngine(apply_fn, mesh, buckets=(1, 2, 4, 8))
+    return engine, params, apply_fn
+
+
+def leg_slo(run_root: str, args) -> None:
+    import jax
+    import numpy as np
+
+    from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
+    from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
+
+    leg = "slo"
+    mesh = mesh_config_from_spec("tp2").build(jax.devices()[:2])
+    engine, params, apply_fn = _lm_engine(mesh, seed=args.seed)
+    engine.swap_params(params, version="init")
+    engine.warmup(np.zeros((SEQ_LEN,), np.int32))
+    traces_after_warmup = engine.trace_counts["infer_step"]
+
+    run_dir = os.path.join(run_root, "slo")
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_delay_s=0.004),
+        run_dir=run_dir,
+        slo_p99_ms=args.slo_p99_ms,
+        pulse_every_s=0.25,
+        input_dtype="int32",
+    ).start()
+    try:
+        def make_row(rng):
+            return rng.integers(0, LM_VOCAB, size=(SEQ_LEN,)).tolist()
+
+        results, errors = open_loop_traffic(
+            server.port,
+            make_row,
+            seed=args.seed,
+            duration_s=args.traffic_s,
+            rate_hz=args.rate_hz,
+            burst_every_s=max(0.5, args.traffic_s / 4),
+            burst_n=6,
+        )
+        _check(not errors, leg, f"transport errors: {errors[:3]}")
+        bad = [r for r in results if r[1] != 200]
+        _check(not bad, leg, f"{len(bad)} non-200 responses, first: {bad[:1]}")
+        _check(len(results) >= 10, leg, f"only {len(results)} requests completed")
+
+        # Correctness spot-check: the served answer for a fixed row equals
+        # a direct (un-served, un-batched at bucket 1) forward pass.
+        row = np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB
+        code, body = _post(server.port, {"tenant": "check", "inputs": [row.tolist()]})
+        _check(code == 200, leg, f"spot-check returned {code}")
+        served = np.asarray(json.loads(body)["outputs"][0])
+        direct = np.asarray(apply_fn(params, row[None, :]))[0]
+        _check(
+            np.allclose(served, direct, rtol=1e-5, atol=1e-5),
+            leg,
+            "served output diverges from direct apply",
+        )
+
+        status = _get_json(server.port, "/status")
+        p99 = status["p99_ms"]
+        _check(p99 is not None, leg, "no p99 in the window after traffic")
+        _check(
+            p99 <= args.slo_p99_ms,
+            leg,
+            f"p99 {p99:.1f} ms breaches the {args.slo_p99_ms:.0f} ms SLO",
+        )
+        _check(status["slo_ok"] is True, leg, f"slo_ok is {status['slo_ok']}")
+        _check(status["qps"] > 0, leg, "window qps is 0 after traffic")
+        # Retrace guard: warmup compiled every bucket; traffic adds nothing.
+        _check(
+            engine.trace_counts["infer_step"] == traces_after_warmup,
+            leg,
+            f"steady-state serving re-traced: {traces_after_warmup} -> "
+            f"{engine.trace_counts['infer_step']}",
+        )
+        print(
+            f"serving_soak: slo OK — {len(results)} requests, "
+            f"p50 {status['p50_ms']:.1f} ms, p99 {p99:.1f} ms "
+            f"(SLO {args.slo_p99_ms:.0f} ms), {status['qps']:.1f} qps, "
+            f"pad_frac {status['pad_frac']:.2f}, 0 retraces"
+        )
+    finally:
+        server.close()
+
+
+def leg_vision(run_root: str, args) -> None:
+    """The replicated leg: a vision model on a pure-data ``dp8`` mesh —
+    params replicate, the batch shards 8-wide, so the smallest legal bucket
+    is 8 and every 1-row request exercises the pad-to-extent path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_pytorch_tpu.models import ViTTiny
+    from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
+    from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
+
+    leg = "vision"
+    mesh = mesh_config_from_spec("dp8").build(jax.devices())
+    model = ViTTiny(num_classes=4)
+    params = model.init(jax.random.key(args.seed), jnp.zeros((1, 8, 8, 3)))["params"]
+
+    def apply_fn(p, x):
+        return model.apply({"params": p}, x)
+
+    from distributed_training_pytorch_tpu.serving import InferEngine
+
+    engine = InferEngine(apply_fn, mesh, buckets=(8, 16))
+    engine.swap_params(params, version="init")
+    engine.warmup(np.zeros((8, 8, 3), np.float32))
+
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_delay_s=0.004),
+        run_dir=os.path.join(run_root, "vision"),
+        slo_p99_ms=args.slo_p99_ms,
+        pulse_every_s=0.25,
+    ).start()
+    try:
+        def make_row(rng):
+            return rng.standard_normal((8, 8, 3)).astype(np.float32).tolist()
+
+        results, errors = open_loop_traffic(
+            server.port,
+            make_row,
+            seed=args.seed + 1,
+            duration_s=max(2.0, args.traffic_s / 2),
+            rate_hz=args.rate_hz / 2,
+            burst_every_s=1.0,
+            burst_n=4,
+        )
+        _check(not errors, leg, f"transport errors: {errors[:3]}")
+        bad = [r for r in results if r[1] != 200]
+        _check(not bad, leg, f"{len(bad)} non-200 responses, first: {bad[:1]}")
+
+        rng = np.random.default_rng(args.seed + 2)
+        row = rng.standard_normal((8, 8, 3)).astype(np.float32)
+        code, body = _post(server.port, {"tenant": "check", "inputs": [row.tolist()]})
+        _check(code == 200, leg, f"spot-check returned {code}")
+        served = np.asarray(json.loads(body)["outputs"][0])
+        direct = np.asarray(apply_fn(params, row[None])).astype(np.float64)[0]
+        _check(
+            np.allclose(served, direct, rtol=1e-4, atol=1e-5),
+            leg,
+            "served vision output diverges from direct apply",
+        )
+        status = _get_json(server.port, "/status")
+        _check(
+            status["pad_frac"] > 0.0,
+            leg,
+            "dp8 with 1-row requests must pad (pad_frac 0 is impossible)",
+        )
+        print(
+            f"serving_soak: vision OK — {len(results)} requests on a "
+            f"replicated dp8 mesh, pad_frac {status['pad_frac']:.2f}, "
+            f"p99 {status['p99_ms']:.1f} ms"
+        )
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: hot-swap bit-identity against a REAL CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def leg_hot_swap(run_root: str, args) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_pytorch_tpu.checkpoint import CheckpointManager
+    from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
+    from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
+    from distributed_training_pytorch_tpu.train.state import TrainState
+
+    leg = "hot_swap"
+    run_dir = os.path.join(run_root, "hot_swap")
+    mesh = mesh_config_from_spec("tp2").build(jax.devices()[:2])
+    engine, params_a, _ = _lm_engine(mesh, seed=args.seed)
+
+    def _state(params):
+        # Minimal real TrainState: serving needs no optimizer, but orbax
+        # refuses an EMPTY composite item, so opt_state carries one scalar.
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=(jnp.zeros((), jnp.float32),),
+            model_state={},
+            rng=jax.random.key(0),
+        )
+
+    mgr = CheckpointManager(os.path.join(run_dir, "weights"), async_save=False)
+    mgr.save("best", _state(params_a), 1)
+
+    target = _state(jax.tree.map(jnp.zeros_like, params_a))
+    engine.restore_params(mgr, target, name="best")
+    engine.warmup(np.zeros((SEQ_LEN,), np.int32))
+    _check(engine.params_version == "best@e1", leg, f"initial restore gave {engine.params_version}")
+
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_delay_s=0.004),
+        run_dir=run_dir,
+        manager=mgr,
+        target_state=target,
+        serve_name="best",
+        swap_poll_s=0.1,
+        slo_p99_ms=args.slo_p99_ms,
+        pulse_every_s=0.25,
+        input_dtype="int32",
+    ).start()
+    try:
+        row = (np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB).tolist()
+        stop = threading.Event()
+        failures: list = []
+
+        def hammer() -> None:
+            # Background load across every swap: any non-200 is a stall or
+            # a torn swap, and fails the leg.
+            rng = np.random.default_rng(args.seed + 3)
+            while not stop.is_set():
+                r = rng.integers(0, LM_VOCAB, size=(SEQ_LEN,)).tolist()
+                try:
+                    code, body = _post(server.port, {"tenant": "load", "inputs": [r]})
+                    if code != 200:
+                        failures.append((code, body[:200]))
+                except Exception as e:  # noqa: BLE001
+                    failures.append((None, repr(e)))
+                time.sleep(0.005)
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+
+        code, body_a = _post(server.port, {"tenant": "check", "inputs": [row]})
+        _check(code == 200, leg, f"pre-swap predict returned {code}")
+
+        def _wait(pred, what: str, timeout: float = 30.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise SoakFailure(f"[{leg}] timed out waiting for {what}")
+
+        # Re-commit IDENTICAL params: the manifest mtime changes, the swap
+        # fires, and the response bytes must not.
+        swaps_before = engine.swap_count
+        mgr.save("best", _state(params_a), 1)
+        _wait(lambda: engine.swap_count > swaps_before, "re-commit swap")
+        code, body_same = _post(server.port, {"tenant": "check", "inputs": [row]})
+        _check(code == 200, leg, f"post-swap predict returned {code}")
+        _check(
+            body_same == body_a,
+            leg,
+            "re-committing identical params changed the response bytes",
+        )
+
+        # Commit NEW params at a higher epoch: version moves, bytes change.
+        _eng, params_b, _fn = _lm_engine(mesh, seed=args.seed + 17)
+        del _eng, _fn
+        mgr.save("best", _state(params_b), 2)
+        _wait(lambda: engine.params_version == "best@e2", "best@e2 swap")
+        code, body_b = _post(server.port, {"tenant": "check", "inputs": [row]})
+        _check(code == 200, leg, f"post-update predict returned {code}")
+        _check(body_b != body_a, leg, "new params produced identical bytes")
+        _check(
+            json.loads(body_b)["params_version"] == "best@e2",
+            leg,
+            f"served version is {json.loads(body_b)['params_version']}",
+        )
+
+        stop.set()
+        th.join(timeout=30.0)
+        _check(
+            not failures,
+            leg,
+            f"{len(failures)} requests failed across swaps, first: {failures[:1]}",
+        )
+
+        from distributed_training_pytorch_tpu.telemetry.events import (
+            read_events,
+            resolve_events_path,
+        )
+
+        swaps = [
+            r for r in read_events(resolve_events_path(run_dir))
+            if r.get("event") == "hot_swap"
+        ]
+        _check(len(swaps) >= 2, leg, f"only {len(swaps)} hot_swap events recorded")
+        _check(
+            swaps[-1]["to_version"] == "best@e2",
+            leg,
+            f"last hot_swap went to {swaps[-1]['to_version']}",
+        )
+        print(
+            f"serving_soak: hot_swap OK — {engine.swap_count} swaps under "
+            f"load, re-commit bit-identical, best@e2 changed the bytes, "
+            f"0 failed requests"
+        )
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: SIGKILL failover under the fleet controller
+# ---------------------------------------------------------------------------
+
+
+def serve_worker(args) -> int:
+    """Child mode: one serving replica on a FIXED port, deterministic
+    params from ``--seed`` (so a respawn is bit-identical), supervised via
+    its run_dir flight recorder. Runs until SIGTERM."""
+    compat.force_host_devices(2)
+    import jax
+    import numpy as np
+
+    from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
+    from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
+
+    mesh = mesh_config_from_spec("tp2").build(jax.devices()[:2])
+    engine, params, _ = _lm_engine(mesh, seed=args.seed)
+    engine.swap_params(params, version=f"seed{args.seed}")
+    engine.warmup(np.zeros((SEQ_LEN,), np.int32))
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_delay_s=0.004),
+        port=args.port,
+        run_dir=args.run_dir,
+        slo_p99_ms=args.slo_p99_ms,
+        pulse_every_s=0.5,
+        input_dtype="int32",
+    ).start()
+    if not server.enabled:
+        return 1
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        server.close()
+    return 0
+
+
+def leg_failover(run_root: str, args) -> None:
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleet_controller import FleetController, RunSpec
+
+    from distributed_training_pytorch_tpu.telemetry.controller import ControllerConfig
+    from distributed_training_pytorch_tpu.telemetry.events import (
+        EventLog,
+        read_events,
+        resolve_events_path,
+    )
+    from distributed_training_pytorch_tpu.telemetry.monitor import AlertConfig
+
+    leg = "failover"
+    run_dir = os.path.join(run_root, "replica0")
+    os.makedirs(run_dir, exist_ok=True)
+    port = _free_port()
+    spec = RunSpec(
+        name="replica0",
+        run_dir=run_dir,
+        kind="serve",
+        cmd=[
+            sys.executable,
+            os.path.abspath(__file__),
+            "--serve-worker",
+            "--run-dir", run_dir,
+            "--port", str(port),
+            "--seed", str(args.seed),
+            "--slo-p99-ms", str(args.slo_p99_ms),
+        ],
+    )
+    ctl_events = EventLog(
+        os.path.join(run_root, "controller_events.jsonl"), process_index=0
+    )
+    ctl = FleetController(
+        [spec],
+        config=ControllerConfig(max_restarts=2, backoff_s=0.1, confirm_polls=1),
+        monitor_config=AlertConfig(stale_after_s=60.0, dead_after_s=120.0),
+        event_log=ctl_events,
+        interval=0.2,
+    )
+    ctl.start()
+    run = ctl.runs["replica0"]
+    try:
+        row = (np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB).tolist()
+        body_before = _wait_serving(port, row)
+
+        # SIGKILL the replica mid-service — no cleanup, no goodbye.
+        run.proc.kill()
+        run.proc.wait(timeout=30)
+
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            ctl.poll_once()
+            if any(a.kind == "restart" for a in run.actions):
+                break
+            time.sleep(0.2)
+        restarts = [a for a in run.actions if a.kind == "restart"]
+        _check(bool(restarts), leg, "controller never issued a restart")
+        _check(restarts[0].reason == "dead", leg, f"restart reason {restarts[0].reason}")
+
+        body_after = _wait_serving(port, row)
+        _check(
+            body_after == body_before,
+            leg,
+            "respawned replica's response differs from the killed one",
+        )
+        recs = read_events(resolve_events_path(run_dir))
+        starts = [r for r in recs if r.get("event") == "serve_start"]
+        _check(
+            len(starts) >= 2 and starts[-1]["attempt"] >= 2,
+            leg,
+            f"expected a second serve_start attempt, got {len(starts)}",
+        )
+        acts = [
+            r
+            for r in read_events(os.path.join(run_root, "controller_events.jsonl"))
+            if r.get("event") == "controller_action" and r.get("action") == "restart"
+        ]
+        _check(bool(acts), leg, "no controller_action restart in the audit log")
+        print(
+            f"serving_soak: failover OK — SIGKILL'd replica respawned by the "
+            f"fleet controller (attempt {starts[-1]['attempt']}), response "
+            f"bit-identical across the failover"
+        )
+    finally:
+        ctl.shutdown()
+        ctl_events.close()
+
+
+# ---------------------------------------------------------------------------
+# Leg 4: zero capacity refuses, never hangs
+# ---------------------------------------------------------------------------
+
+
+def leg_zero_capacity(run_root: str, args) -> None:
+    import jax
+    import numpy as np
+
+    from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
+    from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
+    from distributed_training_pytorch_tpu.telemetry.events import (
+        read_events,
+        resolve_events_path,
+    )
+
+    leg = "zero_capacity"
+    run_dir = os.path.join(run_root, "zero")
+    mesh = mesh_config_from_spec("tp2").build(jax.devices()[:2])
+    engine, params, _ = _lm_engine(mesh, seed=args.seed)
+    engine.swap_params(params, version="init")
+    server = InferenceServer(
+        engine,
+        batcher=MicroBatcher(buckets=engine.buckets, max_queue_depth=0),
+        run_dir=run_dir,
+        pulse_every_s=0.25,
+        input_dtype="int32",
+    ).start()
+    try:
+        row = (np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB).tolist()
+        t0 = time.monotonic()
+        code, body = _post(server.port, {"tenant": "t", "inputs": [row]})
+        wall = time.monotonic() - t0
+        _check(code == 429, leg, f"expected 429, got {code}: {body[:200]}")
+        _check(wall < 2.0, leg, f"refusal took {wall:.2f}s — that is a hang, not a refusal")
+        parsed = json.loads(body)
+        _check(
+            parsed == {"error": "overload", "tenant": "t", "depth": 0, "bound": 0},
+            leg,
+            f"untyped overload body: {parsed}",
+        )
+        rejects = [
+            r for r in read_events(resolve_events_path(run_dir))
+            if r.get("event") == "admission_reject"
+        ]
+        _check(len(rejects) == 1, leg, f"{len(rejects)} admission_reject events")
+        print(
+            f"serving_soak: zero_capacity OK — typed 429 in {wall * 1e3:.0f} ms, "
+            f"1 admission_reject event"
+        )
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Leg 5: import neutrality — serving imported but unused is bit-exact
+# ---------------------------------------------------------------------------
+
+
+def neutrality_worker(args) -> int:
+    """Child mode: a small deterministic TrainEngine run, optionally with
+    the ENTIRE serving stack imported first (package + engine + server —
+    stronger than the package-only import the unit test pins). Prints one
+    JSON line: sha256 of the final params bytes + the engine's trace
+    counts. Two children must print identical lines."""
+    if args.with_serving:
+        import distributed_training_pytorch_tpu.serving  # noqa: F401
+        import distributed_training_pytorch_tpu.serving.engine  # noqa: F401
+        import distributed_training_pytorch_tpu.serving.server  # noqa: F401
+    compat.force_host_devices(2)
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+    from distributed_training_pytorch_tpu.train import TrainEngine, make_supervised_loss
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(3)(x)
+
+    def criterion(logits, batch):
+        loss = cross_entropy_loss(logits, batch["label"])
+        return loss, {"ce_loss": loss}
+
+    mesh = mesh_lib.create_mesh()
+    model = Net()
+    engine = TrainEngine(
+        make_supervised_loss(model, criterion), optax.sgd(0.05, momentum=0.9), mesh
+    )
+    state = engine.init_state(
+        jax.random.key(args.seed), lambda rng: model.init(rng, jnp.zeros((1, 4, 4, 3)))
+    )
+    rng = np.random.RandomState(args.seed)
+    labels = rng.randint(0, 3, size=(16,)).astype(np.int32)
+    images = rng.randn(16, 4, 4, 3).astype(np.float32) + labels[:, None, None, None]
+    batch = engine.shard_batch({"image": images, "label": labels})
+    for _ in range(10):
+        state, _ = engine.train_step(state, batch)
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(state.params)):
+        h.update(np.asarray(leaf).tobytes())
+    print(
+        json.dumps(
+            {
+                "params_sha256": h.hexdigest(),
+                "trace_counts": sorted(dict(engine.trace_counts).items()),
+            }
+        )
+    )
+    return 0
+
+
+def leg_neutrality(run_root: str, args) -> None:
+    import subprocess
+
+    leg = "neutrality"
+    outs = []
+    for flag in ((), ("--with-serving",)):
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--neutrality-worker", "--seed", str(args.seed), *flag,
+        ]
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        _check(
+            proc.returncode == 0, leg,
+            f"worker {cmd[3:]} failed rc={proc.returncode}: {proc.stderr[-400:]}",
+        )
+        outs.append(proc.stdout.strip().splitlines()[-1])
+    _check(
+        outs[0] == outs[1],
+        leg,
+        f"serving import changed the trainer: {outs[0]} != {outs[1]}",
+    )
+    digest = json.loads(outs[0])
+    print(
+        f"serving_soak: neutrality OK — trainer with the full serving stack "
+        f"imported is bit-exact with one that never imported it "
+        f"(params {digest['params_sha256'][:12]}…, traces {digest['trace_counts']})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+
+def run_soak(args) -> int:
+    compat.force_host_devices(8)
+    import tempfile
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="serving_soak_") as run_root:
+        for leg_fn in (leg_slo, leg_vision, leg_hot_swap, leg_failover,
+                       leg_zero_capacity, leg_neutrality):
+            try:
+                leg_fn(run_root, args)
+            except SoakFailure as e:
+                failures.append(str(e))
+                print(f"serving_soak: FAIL {e}", file=sys.stderr)
+    if failures:
+        print(f"serving_soak: {len(failures)} leg(s) FAILED", file=sys.stderr)
+        return 1
+    print("serving_soak: PASS — all legs green")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--quick", action="store_true", help="short CI windows")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--traffic-s", type=float, default=None,
+                        help="open-loop traffic window per leg (default 10, 3 with --quick)")
+    parser.add_argument("--rate-hz", type=float, default=None,
+                        help="Poisson arrival rate (default 60, 30 with --quick)")
+    parser.add_argument("--slo-p99-ms", type=float, default=500.0,
+                        help="p99 SLO asserted by the slo leg and exported by every server")
+    parser.add_argument("--serve-worker", action="store_true",
+                        help="child mode: one supervised replica (failover leg)")
+    parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--neutrality-worker", action="store_true",
+                        help="child mode: short deterministic trainer run (neutrality leg)")
+    parser.add_argument("--with-serving", action="store_true",
+                        help="neutrality child: import the full serving stack first")
+    args = parser.parse_args()
+    if args.traffic_s is None:
+        args.traffic_s = 3.0 if args.quick else 10.0
+    if args.rate_hz is None:
+        args.rate_hz = 30.0 if args.quick else 60.0
+    if args.neutrality_worker:
+        return neutrality_worker(args)
+    if args.serve_worker:
+        return serve_worker(args)
+    return run_soak(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,20 +160,36 @@ class MicroBatcher:
     def submit(self, tenant: str, payload: Any, *, now: "float | None" = None) -> Request:
         """Admit one request, or raise :class:`OverloadRejected` when the
         tenant's bounded queue is full. Never blocks."""
+        return self.submit_many(tenant, (payload,), now=now)[0]
+
+    def submit_many(
+        self, tenant: str, payloads, *, now: "float | None" = None
+    ) -> "list[Request]":
+        """Admit every payload or none: capacity for the whole list is
+        reserved atomically under the lock. A multi-row request that would
+        overflow the tenant's bound is rejected wholesale — rejection can
+        never leave already-admitted orphan rows behind it, still queued
+        and burning compute after the caller was told 429."""
+        payloads = list(payloads)
+        if not payloads:
+            return []
         now = self._clock() if now is None else now
         with self._lock:
             q = self._queues.get(tenant)
             depth = len(q) if q is not None else 0
-            if depth >= self.max_queue_depth:
+            if depth + len(payloads) > self.max_queue_depth:
                 self.rejected[tenant] += 1
                 raise OverloadRejected(tenant, depth, self.max_queue_depth)
             if q is None:
                 q = deque()
                 self._queues[tenant] = q
-            req = Request(id=next(self._ids), tenant=tenant, payload=payload, arrival=now)
-            q.append(req)
-            self.submitted += 1
-            return req
+            reqs = [
+                Request(id=next(self._ids), tenant=tenant, payload=p, arrival=now)
+                for p in payloads
+            ]
+            q.extend(reqs)
+            self.submitted += len(reqs)
+            return reqs
 
     def pending(self) -> int:
         with self._lock:

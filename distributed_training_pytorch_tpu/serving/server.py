@@ -129,8 +129,10 @@ class InferenceServer:
         log=print,
     ):
         self.engine = engine
+        # The default batcher shares the server's clock: Request.arrival and
+        # the latency math in _dispatch_loop must read the same timebase.
         self.batcher = batcher if batcher is not None else MicroBatcher(
-            buckets=engine.buckets
+            buckets=engine.buckets, clock=clock
         )
         self._requested_port = int(port)
         self.host = host
@@ -257,6 +259,20 @@ class InferenceServer:
             t.start()
             self._threads.append(t)
         if self.manager is not None and self.target_state is not None:
+            # A preloaded engine already serving the candidate checkpoint
+            # (version "<name>@e<epoch>" from restore_params) adopts its
+            # identity up front: the watcher's first poll must not redo a
+            # full restore and emit a spurious hot_swap for params the
+            # engine was just loaded with.
+            if self._swap_identity is None and self.engine.params_version is not None:
+                try:
+                    cand = self._swap_candidate()
+                except Exception:  # noqa: BLE001 — racing commit: watcher decides
+                    cand = None
+                if cand is not None and str(self.engine.params_version).startswith(
+                    f"{cand[0]}@"
+                ):
+                    self._swap_identity = cand
             t = threading.Thread(
                 target=self._swap_loop, name="serve-hotswap", daemon=True
             )
@@ -312,9 +328,10 @@ class InferenceServer:
         if inputs.ndim == 0 or inputs.shape[0] == 0:
             return 400, json.dumps({"error": "bad_request", "detail": "empty inputs"}) + "\n"
         try:
-            # One request row per payload: a multi-row POST admits each row
-            # separately so the batcher's fairness applies per row.
-            reqs = [self.batcher.submit(tenant, row) for row in inputs]
+            # One request row per payload so the batcher's fairness applies
+            # per row — admitted atomically, so a 429 on a multi-row POST
+            # never leaves already-queued orphan rows dispatching behind it.
+            reqs = self.batcher.submit_many(tenant, list(inputs))
         except OverloadRejected as e:
             self._note_reject(e)
             return 429, json.dumps(
@@ -345,9 +362,14 @@ class InferenceServer:
         if self.events is None:
             return
         now = self._clock()
-        last_t, pent = self._reject_debounce.get(e.tenant, (0.0, 0))
-        pent += 1
-        if now - last_t >= 1.0:
+        # Handler threads race here: the (last_emit_t, count) read-modify-
+        # write must be atomic or debounced counts drop rejects.
+        with self._lock:
+            last_t, pent = self._reject_debounce.get(e.tenant, (0.0, 0))
+            pent += 1
+            emit = now - last_t >= 1.0
+            self._reject_debounce[e.tenant] = (now, 0) if emit else (last_t, pent)
+        if emit:
             self.events.emit(
                 "admission_reject",
                 attempt=self.attempt,
@@ -357,9 +379,6 @@ class InferenceServer:
                 rejects=pent,
                 rejected_total=int(sum(self.batcher.rejected.values())),
             )
-            self._reject_debounce[e.tenant] = (now, 0)
-        else:
-            self._reject_debounce[e.tenant] = (last_t, pent)
 
     # -- dispatch loop -----------------------------------------------------
 
@@ -375,26 +394,37 @@ class InferenceServer:
                 bound = 0.002 if dl is None else max(0.0, min(dl - now, 0.002))
                 self._stop.wait(bound)
                 continue
-            payloads = np.stack(batch.payloads())
-            t_out = None
-            try:
-                out, version = self.engine.predict(payloads)
-            except Exception as e:  # noqa: BLE001 — answered as 500s, server survives
-                for req in batch.requests:
-                    req.error = f"{type(e).__name__}: {e}"
+            # Per-request validation cannot rule out one batch mixing row
+            # shapes (two tenants posting different feature lengths), so
+            # group by row signature and run each group on its own: the
+            # stack can never throw outside a try and kill this thread, and
+            # well-shaped rows never fail for a neighbor's bad shape.
+            groups: dict = {}
+            for req in batch.requests:
+                row = np.asarray(req.payload)
+                groups.setdefault((row.shape, str(row.dtype)), []).append(req)
+            n_done = 0
+            for reqs in groups.values():
+                try:
+                    payloads = np.stack([np.asarray(r.payload) for r in reqs])
+                    out, version = self.engine.predict(payloads)
+                except Exception as e:  # noqa: BLE001 — answered as 500s, server survives
+                    for req in reqs:
+                        req.error = f"{type(e).__name__}: {e}"
+                        req.done.set()
+                    self._log(f"inference batch failed: {type(e).__name__}: {e}")
+                    continue
+                t_out = self._clock()
+                for i, req in enumerate(reqs):
+                    req.result = out[i]
+                    req.params_version = version
+                    req.completed = t_out
+                    self.window.add(t_out, (t_out - req.arrival) * 1e3)
                     req.done.set()
-                self._log(f"inference batch failed: {type(e).__name__}: {e}")
-                continue
-            t_out = self._clock()
-            for i, req in enumerate(batch.requests):
-                req.result = out[i]
-                req.params_version = version
-                req.completed = t_out
-                self.window.add(t_out, (t_out - req.arrival) * 1e3)
-                req.done.set()
+                n_done += len(reqs)
             with self._lock:
-                self.requests_total += len(batch.requests)
-                self._pulse_state["requests"] += len(batch.requests)
+                self.requests_total += n_done
+                self._pulse_state["requests"] += n_done
                 self._pulse_state["batches"] += 1
             self._maybe_pulse()
         # Drain on shutdown: flush whatever is queued so no handler thread

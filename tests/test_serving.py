@@ -193,6 +193,21 @@ def test_overload_typed_and_counted():
     assert b.pending() == 3
 
 
+def test_submit_many_all_or_nothing():
+    b = _batcher(max_queue_depth=4)
+    b.submit("a", 0)
+    # 3 more rows fit exactly; a 4-row request must not half-admit.
+    with pytest.raises(OverloadRejected) as exc:
+        b.submit_many("a", [1, 2, 3, 4])
+    assert exc.value.depth == 1 and exc.value.bound == 4
+    assert b.pending() == 1  # no orphan rows from the rejected request
+    assert b.submitted == 1 and b.rejected["a"] == 1
+    reqs = b.submit_many("a", [1, 2, 3])
+    assert len(reqs) == 3 and b.pending() == 4
+    assert [r.id for r in reqs] == sorted(r.id for r in reqs)  # FIFO ids
+    assert b.submit_many("a", []) == []  # empty list: no-op, not a reject
+
+
 def test_zero_capacity_refuses_never_hangs():
     b = _batcher(max_queue_depth=0)
     t0 = time.monotonic()
@@ -414,6 +429,69 @@ def test_server_overload_is_typed_429(tmp_path, tp_mesh):
     assert rejects[0]["tenant"] == "t9" and rejects[0]["rejected_total"] == 1
 
 
+def test_server_multi_row_429_leaves_no_orphans(tmp_path, tp_mesh):
+    """A rejected multi-row POST admits nothing: no already-queued rows
+    keep dispatching (and burning compute) after the client's 429."""
+    eng = InferEngine(_linear_apply, tp_mesh, buckets=(1, 2, 4))
+    eng.swap_params(_linear_params(), version="v1")
+    with InferenceServer(
+        eng,
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_queue_depth=2, max_delay_s=5.0),
+        run_dir=str(tmp_path / "orphans"),
+        process_index=0,
+    ) as server:
+        server.start()
+        x3 = [[1.0, 2.0, 3.0, 4.0]] * 3  # 3 rows > depth bound of 2
+        code, body = _post(server.port, {"tenant": "t0", "inputs": x3})
+        assert code == 429 and body["error"] == "overload"
+        assert body["depth"] == 0 and body["bound"] == 2
+        assert server.batcher.pending() == 0  # nothing half-admitted
+        assert server.batcher.submitted == 0
+        # The bound still admits a request that fits, whole.
+        code, body = _post(server.port, {"tenant": "t0", "inputs": x3[:2]})
+        assert code == 200 and len(body["outputs"]) == 2
+
+
+def test_mixed_shape_batch_survives_dispatch(tmp_path, tp_mesh):
+    """Two tenants posting valid rows of different lengths can land in one
+    micro-batch; the dispatch thread must answer (not die on np.stack), and
+    the well-shaped rows must succeed rather than fail for a neighbor."""
+    eng = InferEngine(_linear_apply, tp_mesh, buckets=(1, 2, 4))
+    params = _linear_params(seed=5)
+    eng.swap_params(params, version="v1")
+    with InferenceServer(
+        eng,
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_delay_s=0.2),
+        run_dir=str(tmp_path / "mixed"),
+        process_index=0,
+    ) as server:
+        server.start()
+        # Submit straight into the batcher so both rows share a batch
+        # deterministically (the HTTP path cannot force the timing).
+        good = server.batcher.submit("a", np.ones((4,), np.float32))
+        bad = server.batcher.submit("b", np.ones((8,), np.float32))
+        assert good.wait(10.0) and bad.wait(10.0)
+        assert good.error is None
+        np.testing.assert_allclose(
+            np.asarray(good.result), np.ones((4,), np.float32) @ params["w"],
+            rtol=1e-5,
+        )
+        assert bad.error is not None  # answered as a failure, not a hang
+        # The dispatch thread survived: the server still serves.
+        code, body = _post(server.port, {"inputs": [[1.0, 0.0, 0.0, 0.0]]})
+        assert code == 200 and body["params_version"] == "v1"
+
+
+def test_default_batcher_inherits_server_clock(tp_mesh):
+    """Latency is server-clock-now minus Request.arrival: the batcher the
+    server builds for itself must stamp arrivals on the same clock."""
+    clock = FakeClock(42.0)
+    eng = InferEngine(_linear_apply, tp_mesh, buckets=(1, 2))
+    server = InferenceServer(eng, process_index=0, clock=clock)
+    assert server.batcher._clock is clock
+    assert server.batcher.submit("t", 0).arrival == 42.0
+
+
 def _read_events(run_dir):
     path = resolve_events_path(run_dir)
     with open(path) as f:
@@ -558,6 +636,60 @@ def test_server_hot_swap_under_load(tmp_path, tp_mesh):
     assert len(swaps) >= 2
     assert swaps[0]["checkpoint"] == "best"
     assert swaps[-1]["to_version"] == "best@e2"
+
+
+def test_preloaded_candidate_skips_startup_swap(tmp_path, tp_mesh):
+    """An engine already serving the candidate checkpoint (restored before
+    ``start()``) is not redundantly re-restored by the watcher's first
+    poll, and no spurious startup ``hot_swap`` lands in the recorder; a
+    later re-commit still swaps."""
+    from distributed_training_pytorch_tpu.checkpoint.manager import MANIFEST_NAME
+
+    ckpt = tmp_path / "weights" / "best"
+    ckpt.mkdir(parents=True)
+    manifest = ckpt / MANIFEST_NAME
+    manifest.write_text(json.dumps({"epoch": 1}))
+
+    class Mgr:
+        def exists(self, name):
+            return name == "best"
+
+        def path(self, name):
+            return str(ckpt)
+
+        def latest_valid_name(self):
+            return "best"
+
+        def restore(self, name, target_state, params_only=False):
+            return types.SimpleNamespace(params=_linear_params(seed=11)), 2
+
+    run_dir = tmp_path / "run"
+    eng = InferEngine(_linear_apply, tp_mesh, buckets=(1, 2))
+    eng.swap_params(_linear_params(seed=11), version="best@e1")  # preloaded
+    with InferenceServer(
+        eng,
+        batcher=MicroBatcher(buckets=(1, 2)),
+        run_dir=str(run_dir),
+        manager=Mgr(),
+        target_state=object(),
+        serve_name="best",
+        swap_poll_s=0.05,
+        process_index=0,
+    ) as server:
+        server.start()
+        time.sleep(0.3)  # several watcher polls
+        assert eng.swap_count == 1  # only the preload — no startup re-swap
+        assert eng.params_version == "best@e1"
+        # A real re-commit (manifest mtime changes) still fires the swap.
+        os.utime(manifest, (time.time() + 5, time.time() + 5))
+        deadline = time.monotonic() + 5.0
+        while eng.swap_count < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng.swap_count == 2 and eng.params_version == "best@e2"
+    swaps = [r for r in _read_events(str(run_dir)) if r["event"] == "hot_swap"]
+    assert len(swaps) == 1  # the re-commit only; no spurious startup record
+    assert swaps[0]["from_version"] == "best@e1"
+    assert swaps[0]["to_version"] == "best@e2"
 
 
 # ---------------------------------------------------------------------------

@@ -1245,12 +1245,99 @@ def _bench_serving():
         print(json.dumps({"metric": metric, "value": value, "unit": unit, **common}))
 
 
+def _bench_data():
+    """BENCH_DATA=1 (ISSUE 19 satellite 5): the streaming input-path
+    headline — ``decode_ms_p50`` / ``records_per_s_per_host`` from a
+    loader-only pass over synthetic DTPR1 record shards, plus
+    ``data_wait_frac`` from the SAME streaming trainer workload the perf
+    gate's ``data-wait-cpu`` ceiling measures (``run_doctor``'s self-test
+    harness with ``streaming=True``), one JSON line each,
+    provenance-stamped like every training headline.
+
+    Knobs: ``BENCH_DATA_RECORDS`` (corpus size, default 4096),
+    ``BENCH_DATA_WORKERS`` (decode pool size, default 4).
+    """
+    import shutil
+    import tempfile
+
+    from distributed_training_pytorch_tpu.data import StreamingLoader
+    from distributed_training_pytorch_tpu.data.records import write_shards
+    from distributed_training_pytorch_tpu.telemetry import Telemetry
+    from distributed_training_pytorch_tpu.telemetry import doctor as doctor_lib
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "scripts"))
+    import run_doctor
+
+    n_records = int(os.environ.get("BENCH_DATA_RECORDS", "4096"))
+    num_workers = int(os.environ.get("BENCH_DATA_WORKERS", "4"))
+    batch = 128
+    rng = np.random.default_rng(0)
+    images = rng.random((n_records, 8, 8, 1), dtype=np.float32)
+
+    # -- loader-only pass: decode + pool throughput, no training loop ------
+    tmp = tempfile.mkdtemp(prefix="bench_data_")
+    try:
+        write_shards(
+            os.path.join(tmp, "bench"),
+            ((np.ascontiguousarray(images[i]).tobytes(), int(i % 10))
+             for i in range(n_records)),
+            num_shards=8,
+        )
+        loader = StreamingLoader.from_records(
+            tmp, batch,
+            decode=lambda p: np.frombuffer(p, np.float32).reshape(8, 8, 1),
+            shuffle=True, seed=0, num_workers=num_workers,
+        )
+        t0 = time.monotonic()
+        consumed = 0
+        for b in loader:
+            consumed += len(b["label"])
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        stats = loader.decode_stats()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- trainer pass: steady-state data_wait on the gated workload --------
+    tmp = tempfile.mkdtemp(prefix="bench_data_trainer_")
+    try:
+        trainer = run_doctor._self_test_trainer(
+            tmp, streaming=True,
+            telemetry=Telemetry(anomaly=None, mfu=False), save_period=None,
+        )
+        trainer.train()
+        steady = doctor_lib.steady_fractions(trainer.goodput.to_state())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    provenance = provenance_fields(
+        mesh=None, dtype="float32", chain_steps=2, batch=batch
+    )
+    common = {
+        "workload": "digits-conv-streaming-b128-chain2",
+        "records": n_records,
+        "num_workers": num_workers,
+        "provenance": provenance,
+    }
+    for metric, value, unit in (
+        ("decode_ms_p50", round(stats["decode_ms_p50"], 3), "ms"),
+        ("records_per_s_per_host", round(consumed / elapsed, 1), "rec/s/host"),
+        ("data_wait_frac", round(steady["data_wait"], 4), "frac"),
+    ):
+        print(json.dumps({"metric": metric, "value": value, "unit": unit, **common}))
+
+
 def main():
     # BENCH_SERVE=1: the serving-path headline instead of the training-step
     # measurement — a separate program (forward-only, latency-bound), so the
     # two benches never contaminate each other's allocator high-water marks.
     if os.environ.get("BENCH_SERVE", "") not in ("", "0"):
         _bench_serving()
+        return
+    # BENCH_DATA=1: the streaming input-path headline — loader-only decode
+    # throughput plus the gated data-wait fraction; same opt-in shape.
+    if os.environ.get("BENCH_DATA", "") not in ("", "0"):
+        _bench_data()
         return
     # TUNED=1 (ISSUE 17): adopt the committed TUNED.json winner's knobs as
     # DEFAULTS — chain_steps maps to BENCH_STEPS, pallas to BENCH_PALLAS,

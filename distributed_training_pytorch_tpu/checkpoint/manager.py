@@ -221,6 +221,7 @@ class CheckpointManager:
         loop_state: Mapping | None = None,
         telemetry: Mapping | None = None,
         sharding: Mapping | None = None,
+        data_state: Mapping | None = None,
     ) -> None:
         """Collective save of ``state`` + meta under ``directory/name``.
 
@@ -249,6 +250,15 @@ class CheckpointManager:
         layout the run trained in, and lets a restore into a different mesh
         be detected and logged as a resharding restore
         (docs/parallelism.md).
+
+        ``data_state`` is the streaming reader's checkpoint-carried state
+        (``data.streaming.state.ReaderState.to_json()``: epoch, global
+        record cursor, shuffle seed, shard structure, assignment version).
+        It rides as its own ``data/`` composite item under the same rule as
+        the loss-scale item: present only when the run streams, and a
+        missing item means "fresh cursor" — so pre-streaming checkpoints,
+        non-streaming runs, and streaming runs all restore against any
+        target (:meth:`read_data_state`).
         """
         self.wait()  # a name may be overwritten; finish any in-flight save first
         self._gc_periodic()  # previous save is committed; safe to prune now
@@ -318,6 +328,8 @@ class CheckpointManager:
                 serialization.to_state_dict(scale_state)
             )
             meta["loss_scale"] = type(scale_state).__name__
+        if data_state:
+            items["data"] = ocp.args.JsonSave(dict(data_state))
         args = ocp.args.Composite(meta=ocp.args.JsonSave(meta), **items)
         staging = self._new_staging(name)
         try:
@@ -473,7 +485,12 @@ class CheckpointManager:
         return improved
 
     def maybe_save_best(
-        self, metrics: Mapping, state: Any, epoch: int, telemetry: Mapping | None = None
+        self,
+        metrics: Mapping,
+        state: Any,
+        epoch: int,
+        telemetry: Mapping | None = None,
+        data_state: Mapping | None = None,
     ) -> bool:
         """Apply the best-fitness rule; save under ``best`` on improvement.
 
@@ -481,7 +498,10 @@ class CheckpointManager:
         """
         if not self.best_improved(metrics):
             return False
-        self.save(BEST, state, epoch, metrics=metrics, telemetry=telemetry)
+        self.save(
+            BEST, state, epoch, metrics=metrics, telemetry=telemetry,
+            data_state=data_state,
+        )
         return True
 
     # -- integrity ---------------------------------------------------------
@@ -837,6 +857,24 @@ class CheckpointManager:
             args=ocp.args.Composite(meta=ocp.args.JsonRestore()),
         )
         return dict(restored.meta or {})
+
+    def read_data_state(self, name_or_path: str) -> "dict | None":
+        """The checkpoint's streaming reader state (``data/`` item), or None
+        when the checkpoint has none — a pre-streaming checkpoint or a
+        non-streaming run. The None IS the contract (the loss-scale item
+        rule): a missing item means "fresh cursor", so old checkpoints load
+        into streaming runs without fabricating a position."""
+        self.wait()
+        path = self._resolve(name_or_path)
+        # Gate on the item directory like the scale-item restore does:
+        # requesting an absent composite item from orbax is an error, not
+        # a None.
+        if not os.path.isdir(os.path.join(path, "data")):
+            return None
+        restored = self._ckptr.restore(
+            path, args=ocp.args.Composite(data=ocp.args.JsonRestore())
+        )
+        return dict(restored.data or {})
 
     # -- lifecycle ---------------------------------------------------------
 

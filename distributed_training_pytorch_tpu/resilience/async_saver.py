@@ -182,6 +182,7 @@ class AsyncCheckpointSaver:
         metrics: Mapping | None = None,
         loop_state: Mapping | None = None,
         telemetry: Mapping | None = None,
+        data_state: Mapping | None = None,
     ) -> float:
         """Snapshot ``state`` to host and queue its background commit.
 
@@ -191,7 +192,9 @@ class AsyncCheckpointSaver:
         the same fatality a failed synchronous save has, not vanish.
         """
         self._raise_pending_error()
-        req = self._snapshot(name, state, epoch, metrics, loop_state, telemetry)
+        req = self._snapshot(
+            name, state, epoch, metrics, loop_state, telemetry, data_state
+        )
         with self._cond:
             self._ensure_worker()
             for i, queued in enumerate(self._queue):
@@ -218,6 +221,7 @@ class AsyncCheckpointSaver:
         metrics: Mapping | None = None,
         loop_state: Mapping | None = None,
         telemetry: Mapping | None = None,
+        data_state: Mapping | None = None,
     ) -> float:
         """Emergency save: flush in-flight work, then commit synchronously.
 
@@ -235,7 +239,7 @@ class AsyncCheckpointSaver:
         try:
             self._manager.save(
                 name, state, epoch, metrics=metrics, loop_state=loop_state,
-                telemetry=telemetry,
+                telemetry=telemetry, data_state=data_state,
             )
             self._manager.wait()
         finally:
@@ -248,7 +252,12 @@ class AsyncCheckpointSaver:
         return time.perf_counter() - t0
 
     def maybe_save_best(
-        self, metrics: Mapping, state: Any, epoch: int, telemetry: Mapping | None = None
+        self,
+        metrics: Mapping,
+        state: Any,
+        epoch: int,
+        telemetry: Mapping | None = None,
+        data_state: Mapping | None = None,
     ) -> tuple[bool, float]:
         """Async variant of ``CheckpointManager.maybe_save_best``: apply the
         best-fitness rule on-thread (host floats, free), snapshot + queue on
@@ -256,7 +265,8 @@ class AsyncCheckpointSaver:
         if not self._manager.best_improved(metrics):
             return False, 0.0
         stall = self.save_async(
-            BEST, state, epoch, metrics=metrics, telemetry=telemetry
+            BEST, state, epoch, metrics=metrics, telemetry=telemetry,
+            data_state=data_state,
         )
         return True, stall
 
@@ -300,7 +310,9 @@ class AsyncCheckpointSaver:
 
     # -- internals ---------------------------------------------------------
 
-    def _snapshot(self, name, state, epoch, metrics, loop_state, telemetry):
+    def _snapshot(
+        self, name, state, epoch, metrics, loop_state, telemetry, data_state=None
+    ):
         t0 = time.perf_counter()
         # The sharding-metadata record must come from the LIVE arrays —
         # device_get returns plain host numpy, and a record derived from the
@@ -329,6 +341,10 @@ class AsyncCheckpointSaver:
                 loop_state=loop_state,
                 telemetry=telemetry,
                 sharding=sharding,
+                # Host-side scalars captured at snapshot time (the reader's
+                # position when the state snapshot was taken) — the data
+                # plane's piece of the atomically-consistent save.
+                data_state=data_state,
             ),
         )
         req.snapshot_s = time.perf_counter() - t0

@@ -78,7 +78,18 @@ one checkpoint hot-swap under load (``checkpoint`` name,
 ``from_version``/``to_version``, ``swap_ms``, ``pending_requests``);
 ``admission_reject`` — a typed overload rejection, debounced to one
 record per tenant per second (``tenant``, ``depth`` vs ``bound``,
-``rejects`` since the last record)) — as one JSON object per line,
+``rejects`` since the last record)), and the streaming-data layer's records
+(ISSUE 19, emitted by the Trainer for any loader speaking the
+reader-state surface (``data/streaming``): ``shard_assignment`` — one per
+attempt, on start and on every elastic resume (the assignment ``version``
+fingerprint, ``record_count``/``shard_count``, ``global_batch_size``, this
+host's ``row_lo``/``row_hi`` slice, the ``batch_extent`` it feeds, the
+``resume_batch`` the cursor positions at, and ``elastic`` — whether this
+attempt crossed a topology change); ``data_reader_state`` — one per
+checkpoint save, the reader position a resume from that checkpoint will
+consume from (``name``, resume ``epoch``, global record ``cursor``,
+shuffle ``seed``, ``record_count``, ``assignment_version``)) — as one JSON
+object per line,
 machine-readable and append-only. Since schema 2 every record also carries ``chips`` (this
 process's local device ids) and ``schema`` (:data:`SCHEMA_VERSION`), so
 per-chip attribution survives elastic topology changes and consumers can
@@ -156,8 +167,13 @@ __all__ = [
 #       ``request_batch`` (the server's liveness pulse), ``hot_swap``,
 #       ``admission_reject`` (serving/server.py), and ``offer_chip``
 #       joins the ``controller_action`` action vocabulary (a mixed-fleet
-#       controller offering a freed chip to a serving replica).
-SCHEMA_VERSION = 6
+#       controller offering a freed chip to a serving replica);
+#   7 — the streaming-data vocabulary (ISSUE 19): ``shard_assignment``
+#       (one per attempt: the per-host split of the deterministic global
+#       record sequence — version fingerprint, row range, batch extent,
+#       resume batch) and ``data_reader_state`` (one per checkpoint save:
+#       the epoch/cursor/seed a resume will consume from).
+SCHEMA_VERSION = 7
 
 
 def _jsonable(value: Any) -> Any:

@@ -534,6 +534,17 @@ class Trainer:
         # Datasets + loaders (``:56-71``).
         self.train_dataset = self.build_train_dataset()
         self.train_dataloader = self.build_dataloader(self.train_dataset, phase="train")
+        # Streaming data plane (ISSUE 19; docs/data.md): duck-typed on the
+        # reader-state surface so any build_dataloader override returning a
+        # StreamingLoader gets checkpoint-carried reader state + the
+        # shard_assignment/data_reader_state telemetry without trainer
+        # subclassing. The loader feeds per-host row slices; telling it the
+        # mesh's batch-shard extent pins its assignment version to the
+        # data x fsdp split it actually feeds (PR 9) — which is what makes
+        # an elastic N→M resume visible as a version change.
+        self._streaming_train = hasattr(self.train_dataloader, "reader_state")
+        if self._streaming_train and hasattr(self.train_dataloader, "batch_extent"):
+            self.train_dataloader.batch_extent = self.batch_replicas
         self.val_dataloader = None
         if have_validate:
             self.val_dataset = self.build_val_dataset()
@@ -608,6 +619,33 @@ class Trainer:
             self._resume_step_in_epoch = int(
                 (meta.get("loop") or {}).get("step_in_epoch", 0)
             )
+            # Streaming reader state (ISSUE 19): the checkpoint's data/ item
+            # positions the data plane. Missing item = fresh cursor (a
+            # pre-streaming checkpoint or a non-streaming run — the
+            # loss-scale item rule); present = validate it speaks this
+            # stream and position at cursor // G, O(1). The data cursor is
+            # authoritative for the reader; it cross-checks the loop's
+            # step_in_epoch (same quantity, saved atomically together).
+            if self._streaming_train:
+                data_state = self.checkpoints.read_data_state(snapshot_path)
+                if data_state:
+                    resume_batch = self.train_dataloader.apply_reader_state(
+                        data_state
+                    )
+                    if resume_batch != self._resume_step_in_epoch:
+                        self.log(
+                            "checkpoint data cursor (batch "
+                            f"{resume_batch}) disagrees with loop "
+                            f"step_in_epoch ({self._resume_step_in_epoch}); "
+                            "trusting the data cursor",
+                            "warning",
+                        )
+                        self._resume_step_in_epoch = resume_batch
+                else:
+                    self.log(
+                        "checkpoint has no data/ item (pre-streaming): "
+                        "streaming reader resumes with a fresh cursor"
+                    )
             if self.goodput is not None:
                 # Cumulative goodput counters ride checkpoint meta (the way
                 # loss_scale state rides its checkpoint item): a resumed run
@@ -720,6 +758,19 @@ class Trainer:
                 batch=self.batch_size,
             )
             self.events.emit("run_start", **fields)
+            # Streaming shard assignment (ISSUE 19): one record per attempt
+            # — on an elastic resume the loader's extent was re-planned
+            # above, so the version/extent here IS the re-split assignment
+            # (docs/data.md "elastic re-split ritual").
+            if self._streaming_train and hasattr(self.train_dataloader, "assignment"):
+                self.events.emit(
+                    "shard_assignment",
+                    elastic=self._topology_changed,
+                    **self.train_dataloader.assignment(
+                        cursor=self._resume_step_in_epoch
+                        * self.train_dataloader.global_batch_size
+                    ),
+                )
             # Kernel-policy visibility (ISSUE 17): route ops/dispatch.py's
             # one-time kernel_dispatch decisions into this run's event log.
             # Decisions already made while building the model were buffered
@@ -1151,18 +1202,30 @@ class Trainer:
             self.goodput.tick("other")  # close the epoch-glue interval
         mode = "async" if (self._async_saves and not wait) else "sync"
         telemetry_meta = self._telemetry_meta()
+        # Streaming reader state rides EVERY save (sync/async/emergency/best
+        # — this is the one save site): epoch is the resume epoch the caller
+        # passed, cursor the global records already consumed in it (0 for an
+        # end-of-epoch save; step_in_epoch * G for a preemption save).
+        data_state = None
+        if self._streaming_train:
+            data_state = self.train_dataloader.reader_state(
+                epoch=epoch,
+                batches_consumed=int((loop_state or {}).get("step_in_epoch", 0)),
+            )
         snapshot_s = None
         save_s = None  # full synchronous-save stall (the sync-mode twin of
         #                snapshot_s) — the timeline's `save:` span duration
         if best:
             if mode == "async":
                 saved, snapshot_s = self.saver.maybe_save_best(
-                    metrics, self.state, epoch, telemetry=telemetry_meta
+                    metrics, self.state, epoch, telemetry=telemetry_meta,
+                    data_state=data_state,
                 )
             else:
                 t_save = time.perf_counter()
                 saved = self.checkpoints.maybe_save_best(
-                    metrics, self.state, epoch, telemetry=telemetry_meta
+                    metrics, self.state, epoch, telemetry=telemetry_meta,
+                    data_state=data_state,
                 )
                 save_s = time.perf_counter() - t_save
         else:
@@ -1170,11 +1233,13 @@ class Trainer:
                 snapshot_s = self.saver.save_async(
                     name, self.state, epoch, metrics=metrics,
                     loop_state=loop_state, telemetry=telemetry_meta,
+                    data_state=data_state,
                 )
             else:
                 save_s = self.saver.save_sync(
                     name, self.state, epoch, metrics=metrics,
                     loop_state=loop_state, telemetry=telemetry_meta,
+                    data_state=data_state,
                 )
             saved = True
         if wait:
@@ -1193,6 +1258,19 @@ class Trainer:
             if loop_state:
                 fields["step_in_epoch"] = int(loop_state.get("step_in_epoch", 0))
             self.events.emit("checkpoint_save", **fields)
+            if data_state is not None:
+                # The data plane's save record (ISSUE 19): which records a
+                # resume from this checkpoint will consume next.
+                self.events.emit(
+                    "data_reader_state",
+                    name=name,
+                    reason=reason,
+                    epoch=int(data_state["epoch"]),
+                    cursor=int(data_state["cursor"]),
+                    seed=int(data_state["seed"]),
+                    record_count=int(data_state["record_count"]),
+                    assignment_version=int(data_state["assignment_version"]),
+                )
         return saved
 
     def _write_telemetry_scalars(self) -> None:

@@ -213,12 +213,16 @@ def measure_data_wait(inject_delay_s: float | None = None) -> dict:
     short run's XLA warmup cannot dilute a starved pipeline). The workload
     is ``scripts/run_doctor.py``'s self-test harness — the gate's ceiling
     and the doctor's ``data_bound`` verdict measure the same program
-    through the same fraction definition, so they cannot drift. The
-    loader runs with ``num_workers=0`` so production time is on the
-    consuming thread — the regime where pipeline cost is visible as
-    ``data_wait`` rather than hidden by prefetch overlap (the gate
-    measures the pipeline, not the prefetcher's ability to paper over
-    it)."""
+    through the same fraction definition, so they cannot drift. Since
+    ISSUE 19 the harness runs ``streaming=True``: the gated pipeline is
+    the ``StreamingLoader`` record path (the production input path), not
+    the in-memory array loader. The loader runs with ``num_workers=0``
+    (the serial decode path) so production time is on the consuming
+    thread — the regime where pipeline cost is visible as ``data_wait``
+    rather than hidden by the decode pool's prefetch overlap (the gate
+    measures the pipeline, not the pool's ability to paper over it; the
+    pool's overlap is what the doctor-healthy check in
+    ``scripts/data_soak.py`` asserts)."""
     import shutil
     import tempfile
 
@@ -233,6 +237,7 @@ def measure_data_wait(inject_delay_s: float | None = None) -> dict:
         trainer = run_doctor._self_test_trainer(
             tmp,
             load_delay_s=float(inject_delay_s or 0.0),
+            streaming=True,
             telemetry=Telemetry(anomaly=None, mfu=False),
             save_period=None,  # the gate measures the pipeline, not saves
         )
@@ -242,7 +247,7 @@ def measure_data_wait(inject_delay_s: float | None = None) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
     steady = doctor_lib.steady_fractions(seconds)
     return {
-        "workload": "digits-conv-trainer-b128-chain2",
+        "workload": "digits-conv-streaming-b128-chain2",
         "platform": jax.devices()[0].platform,
         # max vs epsilon: gate.check requires measured > 0, and a pipeline
         # this healthy is a pass at any positive ceiling.

@@ -1,0 +1,403 @@
+"""Streaming data subsystem tests (ISSUE 19; docs/data.md): global-sequence
+purity, host-split/elastic-re-split equivalence, checkpoint-carried reader
+state, the decode pool's respawn/drain contract, and the injection seams the
+doctor/perf-gate/fleet machinery depends on."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_training_pytorch_tpu.checkpoint import CheckpointManager
+from distributed_training_pytorch_tpu.data import (
+    ArrayDataSource,
+    StreamingLoader,
+    shard_array_source,
+)
+from distributed_training_pytorch_tpu.data.records import write_shards
+from distributed_training_pytorch_tpu.data.streaming import (
+    DecodePool,
+    ReaderState,
+    WorkerCrash,
+    assignment_version,
+    global_sequence,
+)
+from distributed_training_pytorch_tpu.parallel.elastic import replan_reader
+
+SIZES = [25, 25, 25, 25]  # 100 records over 4 shards
+
+
+def _source(n=100, seed=0):
+    rng = np.random.RandomState(seed)
+    return ArrayDataSource(
+        image=rng.randn(n, 4, 4, 1).astype(np.float32),
+        label=(np.arange(n) % 10).astype(np.int32),
+    )
+
+
+def _loader(n=100, G=20, **kw):
+    kw.setdefault("num_workers", 0)
+    return StreamingLoader(shard_array_source(_source(n), 4), G, seed=3, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Global-sequence contract: a pure function of (seed, epoch, shard structure).
+
+
+def test_global_sequence_pure_function():
+    a = global_sequence(7, 2, SIZES)
+    b = global_sequence(7, 2, SIZES)
+    np.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(100))  # a permutation, no loss
+    assert not np.array_equal(a, global_sequence(7, 3, SIZES))
+    assert not np.array_equal(a, global_sequence(8, 2, SIZES))
+
+
+def test_global_sequence_unshuffled_is_identity():
+    np.testing.assert_array_equal(
+        global_sequence(7, 2, SIZES, shuffle=False), np.arange(100)
+    )
+
+
+def test_global_sequence_is_shard_major():
+    """Shuffle = shard-order permutation + within-shard permutations: each
+    consecutive size-25 slice of the sequence stays inside ONE shard's id
+    range (streaming reads touch one shard at a time)."""
+    seq = global_sequence(7, 0, SIZES)
+    for lo in range(0, 100, 25):
+        chunk = seq[lo : lo + 25]
+        assert chunk.max() - chunk.min() < 25
+        assert chunk.min() % 25 == 0
+
+
+def test_host_split_disjoint_cover():
+    """Every host's rows per batch tile the global batch exactly — no record
+    read twice, none dropped."""
+    G, P = 20, 4
+    loaders = [
+        _loader(G=G, process_index=p, process_count=P) for p in range(P)
+    ]
+    batches = [list(ld.iter_batches(0)) for ld in loaders]
+    ref = _loader(G=G)
+    for b, full in enumerate(ref.iter_batches(0)):
+        got = np.concatenate([batches[p][b]["label"] for p in range(P)])
+        np.testing.assert_array_equal(got, full["label"])
+
+
+def test_resplit_equivalence_8_4():
+    """The tentpole claim: 8 hosts, 4 hosts, and 1 host consume the SAME
+    global record sequence — per-host splits change, the sequence does not
+    — including when resuming mid-epoch from a cursor."""
+    G = 40
+    for start in (0, 1):  # fresh epoch and a mid-epoch resume
+        seqs = {}
+        for P in (1, 4, 8):
+            parts = [
+                [b["label"] for b in _loader(
+                    G=G, process_index=p, process_count=P
+                ).iter_batches(start)]
+                for p in range(P)
+            ]
+            seqs[P] = [
+                np.concatenate([parts[p][i] for p in range(P)])
+                for i in range(len(parts[0]))
+            ]
+        for P in (4, 8):
+            assert len(seqs[P]) == len(seqs[1])
+            for a, b in zip(seqs[P], seqs[1], strict=True):
+                np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Reader state: the checkpoint-carried cursor.
+
+
+def test_reader_state_round_trip():
+    ld = _loader(G=20)
+    ld.set_epoch(2)
+    state = ld.reader_state(batches_consumed=3)
+    assert state["epoch"] == 2 and state["cursor"] == 60
+    fresh = _loader(G=20)
+    assert fresh.apply_reader_state(state) == 3  # resume batch, O(1)
+    assert fresh._epoch == 2
+
+
+def test_reader_state_json_schema_guard():
+    state = ReaderState.from_json(_loader().reader_state())
+    assert state.schema == 1
+    newer = dict(_loader().reader_state(), schema=99)
+    with pytest.raises(ValueError, match="schema"):
+        ReaderState.from_json(newer)
+
+
+def test_apply_reader_state_rejects_foreign_stream():
+    state = _loader(G=20).reader_state()
+    other = StreamingLoader(shard_array_source(_source(80), 4), 20, seed=3)
+    with pytest.raises(ValueError, match="record count"):
+        other.apply_reader_state(state)
+
+
+def test_manager_data_item_round_trip(tmp_path, devices):
+    """The data/ composite item mirrors the PR 3 scale-item rule: present →
+    restored verbatim; absent (a pre-streaming checkpoint) → None, meaning
+    the reader keeps its fresh default cursor."""
+    from tests.test_checkpoint import _small_state
+
+    _, state = _small_state(devices, seed=0)
+    mgr = CheckpointManager(tmp_path / "c", async_save=False)
+    data_state = _loader(G=20).reader_state(epoch=1, batches_consumed=2)
+    mgr.save("with_data", state, epoch=1, data_state=data_state)
+    mgr.save("without_data", state, epoch=1)
+    restored = mgr.read_data_state("with_data")
+    assert restored == dict(data_state)
+    assert mgr.read_data_state("without_data") is None  # fresh-cursor rule
+    mgr.close()
+
+
+def test_replan_reader_resplits_from_cursor():
+    """parallel/elastic.py's data-plane half: the re-planned axes produce a
+    new assignment version + per-host split, but the same resume batch."""
+    old = replan_reader(
+        {"data": 1, "fsdp": 8}, shard_sizes=SIZES, global_batch_size=20,
+        cursor=60, process_index=0, process_count=1,
+    )
+    new = replan_reader(
+        {"data": 1, "fsdp": 4}, shard_sizes=SIZES, global_batch_size=20,
+        cursor=60, process_index=0, process_count=1,
+    )
+    assert old["batch_extent"] == 8 and new["batch_extent"] == 4
+    assert old["version"] != new["version"]  # the re-split is visible
+    assert old["resume_batch"] == new["resume_batch"] == 3  # the cursor is not
+    assert new["version"] == assignment_version(
+        record_count=100, shard_count=4, global_batch_size=20,
+        process_count=1, batch_extent=4,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Decode pool: bounded workers, crash respawn, shutdown drain.
+
+
+def _stream_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("stream-decode")]
+
+
+def test_pool_shutdown_drains_workers():
+    with DecodePool(3) as pool:
+        tasks = [pool.submit(lambda x: x * x, i) for i in range(20)]
+        assert [t.result(pool) for t in tasks] == [i * i for i in range(20)]
+        assert len(_stream_threads()) == 3
+    assert pool.shutdown() == []  # idempotent, nothing leaked
+    assert _stream_threads() == []
+
+
+def test_pool_respawns_crashed_worker():
+    crashed = []
+
+    def work(i):
+        if i == 5 and not crashed:
+            crashed.append(i)
+            raise WorkerCrash("injected")
+        return i
+
+    with DecodePool(2) as pool:
+        tasks = [pool.submit(work, i) for i in range(10)]
+        assert [t.result(pool) for t in tasks] == list(range(10))
+        assert pool.respawns >= 1 and pool.crashes >= 1
+    assert _stream_threads() == []
+
+
+def test_pool_ordinary_error_does_not_kill_worker():
+    def work(i):
+        if i == 1:
+            raise ValueError("bad record")
+        return i
+
+    with DecodePool(1) as pool:
+        tasks = [pool.submit(work, i) for i in range(3)]
+        assert tasks[0].result(pool) == 0
+        with pytest.raises(ValueError, match="bad record"):
+            tasks[1].result(pool)
+        assert tasks[2].result(pool) == 2  # the worker survived
+        assert pool.respawns == 0
+
+
+def test_loader_crash_on_batch_reproduces_batch():
+    """A decode-worker death re-enqueues the batch: pooled output equals the
+    serial loader's, respawn counted, no threads leaked."""
+    serial = [b["label"] for b in _loader(G=20)]
+    pooled_loader = _loader(G=20, num_workers=2)
+    pooled_loader.crash_on_batch = 1
+    pooled = [b["label"] for b in pooled_loader]
+    for a, b in zip(serial, pooled, strict=True):
+        np.testing.assert_array_equal(a, b)
+    assert pooled_loader.respawns >= 1 and pooled_loader.crashes >= 1
+    assert _stream_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# The seams the doctor / perf gate / fleet controller depend on.
+
+
+def test_injection_seams_present():
+    """load_delay_s + prefetch_batches are load-bearing API: run_doctor's
+    data_bound self-test, perf_gate --inject-data-wait, and the fleet
+    controller's prefetch tune all reach through them (ISSUE 19 satellite)."""
+    ld = _loader(G=20, num_workers=2, prefetch_batches=5)
+    assert ld.load_delay_s == 0.0
+    assert ld.prefetch_batches == 5
+
+
+def test_load_delay_seam_starves_serial_path():
+    ld = _loader(G=20)
+    ld.load_delay_s = 0.02
+    t0 = time.perf_counter()
+    n = sum(1 for _ in ld)
+    assert time.perf_counter() - t0 >= n * 0.02  # every batch slept
+
+
+def test_skip_corrupt_accounting(tmp_path):
+    def records():
+        for i in range(40):
+            payload = np.full((4,), i, np.float32).tobytes()
+            if i == 7:
+                payload = b"XXX"  # not a multiple of 4: undecodable
+            yield payload, i % 10
+
+    write_shards(str(tmp_path / "s"), records(), num_shards=4)
+    decode = lambda p: np.frombuffer(p, np.float32)  # noqa: E731
+
+    ld = StreamingLoader.from_records(
+        str(tmp_path), 10, decode=decode, skip_corrupt=True, seed=0,
+    )
+    batches = list(ld)
+    assert len(batches) == 4 and all(len(b["label"]) == 10 for b in batches)
+    assert ld.corrupt_skipped >= 1
+
+    strict = StreamingLoader.from_records(str(tmp_path), 10, decode=decode, seed=0)
+    with pytest.raises(Exception, match="(?i)corrupt|decode"):
+        list(strict)
+
+
+def test_record_log_reconstructs_sequence(tmp_path):
+    log_path = str(tmp_path / "records.jsonl")
+    ld = _loader(G=20, record_log_path=log_path)
+    consumed = [b["label"] for b in ld.iter_batches(0)]
+    lines = [json.loads(x) for x in open(log_path)]
+    assert [r["batch"] for r in lines] == list(range(len(consumed)))
+    order = ld._global_order()
+    for rec in lines:
+        b = rec["batch"]
+        np.testing.assert_array_equal(rec["ids"], order[b * 20 : (b + 1) * 20])
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: the data/ item rides every save; resume applies it.
+
+
+class _StreamNet:
+    pass
+
+
+@pytest.fixture(scope="module")
+def stream_trained(tmp_path_factory, devices):
+    import optax
+    from flax import linen as nn
+
+    from distributed_training_pytorch_tpu.ops import cross_entropy_loss
+    from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+    from distributed_training_pytorch_tpu.trainer import Trainer
+
+    tmp = tmp_path_factory.mktemp("stream_trained")
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train=False):
+            return nn.Dense(10)(x.reshape(x.shape[0], -1))
+
+    class StreamTrainer(Trainer):
+        def build_train_dataset(self):
+            return _source(96, seed=0)
+
+        def build_dataloader(self, dataset, phase="train"):
+            return StreamingLoader(
+                shard_array_source(dataset, 4), self.batch_size,
+                seed=self.seed, num_workers=0, drop_last=True,
+            )
+
+        def build_model(self):
+            return Net()
+
+        def build_criterion(self):
+            def criterion(logits, batch):
+                loss = cross_entropy_loss(logits, batch["label"])
+                return loss, {"loss": loss}
+
+            return criterion
+
+        def build_optimizer(self, schedule):
+            return optax.sgd(schedule)
+
+        def build_scheduler(self):
+            return 0.1
+
+    mesh = mesh_lib.create_mesh(
+        {mesh_lib.DATA_AXIS: len(devices)}, devices=devices
+    )
+
+    def make(max_epoch):
+        return StreamTrainer(
+            max_epoch=max_epoch, batch_size=16, save_folder=str(tmp),
+            snapshot_path="latest_valid", save_period=1, have_validate=False,
+            telemetry="on", num_workers=0, log_every=0, progress=False,
+            async_checkpoint=False, mesh=mesh,
+        )
+
+    trainer = make(2)
+    trainer.train()
+    resumed = make(3)
+    resumed.train()
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(tmp, "telemetry", "events.jsonl"))
+    ]
+    return trainer, resumed, events, str(tmp)
+
+
+def test_trainer_marks_streaming_and_extent(stream_trained, devices):
+    trainer, _, _, _ = stream_trained
+    assert trainer._streaming_train
+    assert trainer.train_dataloader.batch_extent == len(devices)
+
+
+def test_every_save_carries_data_item(stream_trained):
+    _, _, _, tmp = stream_trained
+    weights = os.path.join(tmp, "weights")
+    saves = [d for d in os.listdir(weights) if not d.startswith(".")]
+    assert saves
+    for name in saves:
+        meta = os.path.join(weights, name, "data", "metadata")
+        assert os.path.isfile(meta), f"{name} missing its data/ item"
+        item = json.load(open(meta))
+        assert item["record_count"] == 96 and item["global_batch_size"] == 16
+
+
+def test_streaming_events_emitted(stream_trained, devices):
+    _, _, events, _ = stream_trained
+    assigns = [e for e in events if e["event"] == "shard_assignment"]
+    states = [e for e in events if e["event"] == "data_reader_state"]
+    assert len(assigns) >= 2  # one per attempt (initial + resume)
+    assert all(a["batch_extent"] == len(devices) for a in assigns)
+    assert states and all(
+        e["assignment_version"] == assigns[0]["version"] for e in states
+    )
+
+
+def test_resume_applies_reader_state(stream_trained):
+    _, resumed, events, _ = stream_trained
+    restores = [e for e in events if e["event"] == "checkpoint_restore"]
+    assert restores  # the epoch-3 run resumed from the epoch-2 save
+    assert int(resumed.state.step) == 3 * 6  # 96/16 batches x 3 epochs total

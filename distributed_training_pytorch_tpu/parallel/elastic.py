@@ -75,6 +75,7 @@ __all__ = [
     "validate_topology",
     "replan",
     "replan_accum",
+    "replan_absorbing",
     "replan_excluding",
     "replan_reader",
     "nearest_divisible_accum",
@@ -384,4 +385,39 @@ def replan_excluding(
         plan,
         reason=plan.reason
         + f" (excluding degraded chip(s) {','.join(str(d) for d in dropped)})",
+    )
+
+
+def replan_absorbing(
+    record_or_axes: Mapping,
+    device_ids,
+    absorb,
+    *,
+    batch_size: int | None = None,
+    accum_steps: int = 1,
+) -> ElasticPlan:
+    """:func:`replan_excluding`'s grow twin (ISSUE 20): re-plan a mesh onto
+    the devices in ``device_ids`` PLUS the offered ``absorb`` ids — the
+    fleet controller's chip-offer actuation entry. When a trainer's
+    ``restart_excluding`` frees a chip, the accepted offer re-plans the
+    serving replica's mesh onto its current devices plus the freed one
+    through the same solver rules an elastic grow uses (model-sharding
+    axes preserved-or-refused, the extra device landing on the batch
+    axes). Offered ids already present are ignored (idempotent re-offer);
+    divisibility failures propagate as :class:`ElasticReplanError` — the
+    controller treats them as "cannot absorb, revert the handshake"."""
+    ids = [int(d) for d in device_ids]
+    added = sorted({int(d) for d in absorb} - set(ids))
+    plan = replan(
+        record_or_axes,
+        len(ids) + len(added),
+        batch_size=batch_size,
+        accum_steps=accum_steps,
+    )
+    if not added:
+        return plan
+    return dataclasses.replace(
+        plan,
+        reason=plan.reason
+        + f" (absorbing offered chip(s) {','.join(str(d) for d in added)})",
     )

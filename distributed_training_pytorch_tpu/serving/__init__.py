@@ -15,10 +15,17 @@ of inventing a serving-only twin:
   ``best``) and hot-swapped by atomic reference flip.
 * :mod:`.server`  — :class:`~.server.InferenceServer`: the rank-0 stdlib
   HTTP server (the PR 15 exporter pattern) exposing ``/predict``,
-  ``/status`` and ``/metrics`` (p50/p99 latency, QPS/chip), emitting the
-  serving event vocabulary (``serve_start`` / ``request_batch`` /
-  ``hot_swap`` / ``admission_reject``) into the same JSONL flight
+  ``/status`` and ``/metrics`` (p50/p99 latency, QPS/chip), plus the
+  ISSUE 20 drain/re-plan admin surface (``/admin/offer``,
+  ``/admin/replan``), emitting the serving event vocabulary
+  (``serve_start`` / ``request_batch`` / ``hot_swap`` /
+  ``admission_reject`` / ``offer_accept`` / ``offer_decline`` /
+  ``drain_start`` / ``replan_done``) into the same JSONL flight
   recorder the fleet monitor and controller already read.
+* :mod:`.client`  — :class:`~.client.RetryClient`: the caller's half of
+  the backpressure contract (ISSUE 20) — jittered exponential backoff
+  honoring ``Retry-After``, bounded attempts, typed give-up
+  (:class:`~.client.RetriesExhausted`). Pure stdlib, no jax import.
 
 Import neutrality: importing this package (or any submodule) has no
 side effects on the training path — no backend init, no global config
@@ -36,11 +43,15 @@ from distributed_training_pytorch_tpu.serving.batcher import (  # noqa: F401
 
 # The device-touching layers resolve lazily (PEP 562): the package import
 # stays jax-free (the neutrality contract above), but callers still write
-# ``from ...serving import InferEngine, InferenceServer``.
+# ``from ...serving import InferEngine, InferenceServer``. The client is
+# jax-free but lazy too — its urllib import would otherwise drag the
+# whole http/email stack into every trainer that imports serving.
 _LAZY = {
     "InferEngine": "distributed_training_pytorch_tpu.serving.engine",
     "InferenceServer": "distributed_training_pytorch_tpu.serving.server",
     "LatencyWindow": "distributed_training_pytorch_tpu.serving.server",
+    "RetriesExhausted": "distributed_training_pytorch_tpu.serving.client",
+    "RetryClient": "distributed_training_pytorch_tpu.serving.client",
 }
 
 
@@ -60,5 +71,7 @@ __all__ = [
     "MicroBatcher",
     "OverloadRejected",
     "Request",
+    "RetriesExhausted",
+    "RetryClient",
     "pick_bucket",
 ]

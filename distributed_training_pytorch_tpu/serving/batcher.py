@@ -92,6 +92,10 @@ class Request:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Any = None
     error: "str | None" = None
+    # HTTP status an errored request maps to: 500 for an inference failure,
+    # 503 when a drain's bounded deadline shed the request before dispatch
+    # (typed, with Retry-After — never a hang or a dropped row; ISSUE 20).
+    error_code: int = 500
     params_version: "str | None" = None
     completed: float = 0.0
 

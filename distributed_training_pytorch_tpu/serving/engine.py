@@ -98,6 +98,7 @@ class InferEngine:
         # retrace-guard contract): steady-state serving re-traces nothing.
         self.trace_counts: Counter = Counter()
         self.swap_count = 0
+        self.replan_count = 0
         self._swap_lock = threading.Lock()  # one restore-and-flip at a time
 
     # -- params ------------------------------------------------------------
@@ -175,6 +176,61 @@ class InferEngine:
             version = f"{used}@e{epoch}"
             self.swap_params(state.params, version=version)
             return version
+
+    # -- live re-plan --------------------------------------------------------
+
+    def replan_onto(self, mesh) -> None:
+        """Rebind the engine to a re-planned ``mesh`` (ISSUE 20): the live
+        re-plan half of the drain handshake. Pulls the served params back
+        to host, swaps in the new mesh's batch/params shardings, drops
+        every compiled executable (they close over the OLD mesh's
+        shardings), then re-places the identical param bytes under the new
+        layout — the params version does not change, because the bytes do
+        not, so responses for identical inputs are bit-identical across
+        the re-plan (batch-axis growth never changes per-row math; a
+        model-sharding change is refused upstream by the elastic solver).
+
+        Validation happens BEFORE any state is touched: an infeasible
+        target (a bucket not dividing the new batch-shard extent) raises
+        ``ValueError`` and leaves the engine serving the old plan — the
+        handshake's revert path depends on that. The caller must have
+        quiesced dispatch first (the server's drain owns that); the swap
+        lock here only excludes a concurrent ``restore_params``."""
+        extent = mesh_lib.batch_shard_extent(mesh)
+        bad = [b for b in self.buckets if b % extent]
+        if bad:
+            raise ValueError(
+                f"buckets {bad} do not divide the re-planned mesh's "
+                f"batch-shard extent {extent} (mesh {dict(mesh.shape)}): "
+                "cannot re-plan this engine onto that device set"
+            )
+        with self._swap_lock:
+            cur = self._current
+            host = None
+            if cur is not None:
+                version, placed = cur
+                host = jax.tree.map(
+                    lambda x: np.asarray(jax.device_get(x)), placed
+                )
+            self.mesh = mesh
+            self._batch_sharding = mesh_lib.batch_sharding(mesh)
+            self._replicated = NamedSharding(mesh, P())
+            self._params_sharding = None
+            self._params_structure = None
+            self._executables = {}
+            self.replan_count += 1
+            if host is not None:
+                sharding = self._sharding_for(host)
+                placed = jax.device_put(host, sharding)
+                jax.tree.map(
+                    lambda x: (
+                        x.block_until_ready()
+                        if hasattr(x, "block_until_ready")
+                        else x
+                    ),
+                    placed,
+                )
+                self._current = (version, placed)
 
     # -- the compiled forward ----------------------------------------------
 

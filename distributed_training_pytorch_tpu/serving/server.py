@@ -21,10 +21,31 @@ via ``InferEngine.swap_params`` — one atomic reference flip. In-flight
 batches finish on the params they started with; no request ever stalls
 on a swap (docs/serving.md "Hot-swap state machine").
 
+Graceful drain + live re-plan (ISSUE 20 tentpole): the server owns a
+three-state admission machine — ``serving -> draining -> replanning ->
+serving``. :meth:`InferenceServer.drain` stops admitting (new requests
+get a typed 503 with a ``Retry-After`` derived from live queue depth),
+flushes in-flight micro-batches to completion under a bounded deadline,
+and answers whatever is still *queued* past the deadline with the same
+typed 503 — never a hang, never a dropped row. :meth:`drain_and_replan`
+then rebuilds the ``InferEngine``'s executables on a new device set
+through the elastic solver (``parallel.elastic.replan``), warms the
+buckets, and resumes — response bytes for identical params are
+bit-identical across the re-plan. The checkpoint-watch thread is gated
+behind the same state machine: a commit landing mid-drain cannot flip
+params while the engine is being rebuilt. ``POST /admin/offer`` /
+``POST /admin/replan`` are the fleet controller's handshake transport
+(offer -> accept/decline -> actuate -> confirm); a replica under SLO
+pressure declines.
+
 Observability rides the existing flight recorder: the server claims an
 attempt id and emits ``serve_start`` / ``request_batch`` (a ~1 Hz
-summary pulse that doubles as the liveness heartbeat) / ``hot_swap`` /
-``admission_reject`` (debounced per tenant) into
+summary pulse that doubles as the liveness heartbeat — it keeps firing
+mid-drain/re-plan, stamped with the admission state, so the monitor
+never reads a draining replica as dead) / ``hot_swap`` /
+``admission_reject`` (debounced per tenant, carrying the Retry-After it
+answered with) / ``drain_start`` / ``replan_done`` /
+``offer_accept`` / ``offer_decline`` into
 ``<run_dir>/telemetry/events.jsonl`` — so ``RunMonitor``, the fleet
 table, and the fleet controller supervise a server exactly like a
 trainer (docs/observability.md).
@@ -33,6 +54,7 @@ trainer (docs/observability.md).
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -156,6 +178,17 @@ class InferenceServer:
         self._server: "ThreadingHTTPServer | None" = None
         self._started = 0.0
         self.requests_total = 0
+        # Admission state machine (ISSUE 20): "serving" admits; "draining"
+        # refuses admission while in-flight batches flush under a bounded
+        # deadline; "replanning" refuses while the engine rebuilds on a new
+        # device set. Transitions happen under _lock; readers take the GIL
+        # snapshot (a stale read costs one extra 503, never a torn state).
+        self.state = "serving"
+        self._drain_deadline: "float | None" = None
+        self._inflight = 0  # micro-batches currently executing in dispatch
+        self.drain_count = 0
+        self.shed_total = 0  # requests answered a drain-window 503
+        self._warm_row = None  # first served row: the post-replan warmup sig
         self._swap_identity = None
         self._reject_debounce: dict = {}  # tenant -> (last_emit_t, count_since)
         self._pulse_state = {"t": 0.0, "requests": 0, "batches": 0}
@@ -206,29 +239,50 @@ class InferenceServer:
 
             def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
                 route = self.path.split("?", 1)[0].rstrip("/")
-                if route != "/predict":
-                    self._respond(404, "text/plain", "POST /predict only\n")
-                    return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    tenant = str(body.get("tenant", "default"))
-                    inputs = np.asarray(body["inputs"], dtype=server.input_dtype)
-                except (KeyError, TypeError, ValueError) as e:
+                except (TypeError, ValueError) as e:
                     self._respond(
                         400, "application/json",
                         json.dumps({"error": "bad_request", "detail": str(e)}) + "\n",
                     )
                     return
-                code, payload = server.handle_predict(tenant, inputs)
-                self._respond(code, "application/json", payload)
+                if route == "/predict":
+                    try:
+                        tenant = str(body.get("tenant", "default"))
+                        inputs = np.asarray(
+                            body["inputs"], dtype=server.input_dtype
+                        )
+                    except (KeyError, TypeError, ValueError) as e:
+                        self._respond(
+                            400, "application/json",
+                            json.dumps(
+                                {"error": "bad_request", "detail": str(e)}
+                            ) + "\n",
+                        )
+                        return
+                    code, payload, headers = server.handle_predict(tenant, inputs)
+                elif route == "/admin/offer":
+                    code, payload, headers = server.handle_offer(body)
+                elif route == "/admin/replan":
+                    code, payload, headers = server.handle_replan(body)
+                else:
+                    self._respond(
+                        404, "text/plain",
+                        "POST /predict, /admin/offer or /admin/replan\n",
+                    )
+                    return
+                self._respond(code, "application/json", payload, headers)
 
-            def _respond(self, code: int, ctype: str, body: str):
+            def _respond(self, code: int, ctype: str, body: str, headers=None):
                 try:
                     payload = body.encode("utf-8")
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(payload)))
+                    for key, value in (headers or {}).items():
+                        self.send_header(key, str(value))
                     self.end_headers()
                     self.wfile.write(payload)
                 except OSError:
@@ -320,20 +374,66 @@ class InferenceServer:
 
     # -- request path ------------------------------------------------------
 
-    def handle_predict(self, tenant: str, inputs: np.ndarray) -> "tuple[int, str]":
-        """Admit -> wait -> answer. Returns (HTTP code, JSON body). The
-        response body is a pure function of (inputs, served params): no
-        timestamps or latencies in it, so equal params produce equal bytes
-        across a hot-swap boundary (the soak's bit-identity leg)."""
+    def retry_after_s(self) -> int:
+        """Advisory seconds before a refused caller should retry — the
+        ``Retry-After`` header on every 429/503 (ISSUE 20 satellite 1),
+        derived from live queue depth: pending rows amortized over the
+        largest bucket estimate the batches ahead, each costing about one
+        admission window plus the trailing p50 service time. Mid-drain the
+        remaining drain budget floors the answer — retrying into a replica
+        that is still flushing or recompiling cannot succeed sooner."""
+        depth = self.batcher.pending()
+        win = self.window.snapshot()
+        per_batch_s = self.batcher.max_delay_s + ((win["p50_ms"] or 0.0) / 1e3)
+        est = ((depth // self.batcher.buckets[-1]) + 1) * per_batch_s
+        dl = self._drain_deadline
+        if self.state != "serving" and dl is not None:
+            est = max(est, dl - self._clock())
+        return max(1, math.ceil(est))
+
+    def handle_predict(
+        self, tenant: str, inputs: np.ndarray
+    ) -> "tuple[int, str, dict | None]":
+        """Admit -> wait -> answer. Returns (HTTP code, JSON body, extra
+        headers or None). The response body is a pure function of (inputs,
+        served params): no timestamps or latencies in it, so equal params
+        produce equal bytes across a hot-swap or re-plan boundary (the
+        soak's bit-identity legs). While the server drains or re-plans,
+        admission answers a typed 503 with Retry-After — the degraded-mode
+        shed contract callers' retry loops key off."""
         if inputs.ndim == 0 or inputs.shape[0] == 0:
-            return 400, json.dumps({"error": "bad_request", "detail": "empty inputs"}) + "\n"
+            return 400, json.dumps(
+                {"error": "bad_request", "detail": "empty inputs"}
+            ) + "\n", None
+        state = self.state
+        if state != "serving":
+            ra = self.retry_after_s()
+            with self._lock:
+                self.shed_total += 1
+            self._note_reject(
+                tenant,
+                depth=self.batcher.pending(),
+                bound=self.batcher.max_queue_depth,
+                reason=state,
+                retry_after_s=ra,
+            )
+            return 503, json.dumps(
+                {"error": "draining", "state": state, "retry_after_s": ra}
+            ) + "\n", {"Retry-After": str(ra)}
         try:
             # One request row per payload so the batcher's fairness applies
             # per row — admitted atomically, so a 429 on a multi-row POST
             # never leaves already-queued orphan rows dispatching behind it.
             reqs = self.batcher.submit_many(tenant, list(inputs))
         except OverloadRejected as e:
-            self._note_reject(e)
+            ra = self.retry_after_s()
+            self._note_reject(
+                e.tenant,
+                depth=e.depth,
+                bound=e.bound,
+                reason="overload",
+                retry_after_s=ra,
+            )
             return 429, json.dumps(
                 {
                     "error": "overload",
@@ -341,50 +441,305 @@ class InferenceServer:
                     "depth": e.depth,
                     "bound": e.bound,
                 }
-            ) + "\n"
+            ) + "\n", {"Retry-After": str(ra)}
         deadline = self._clock() + self.request_timeout_s
         for req in reqs:
             if not req.wait(max(0.0, deadline - self._clock())):
-                return 504, json.dumps({"error": "timeout"}) + "\n"
+                return 504, json.dumps({"error": "timeout"}) + "\n", None
             if req.error is not None:
-                return 500, json.dumps({"error": "inference_failed", "detail": req.error}) + "\n"
+                if req.error_code == 503:
+                    # Shed by a drain deadline: typed, timed, retryable.
+                    ra = self.retry_after_s()
+                    return 503, json.dumps(
+                        {
+                            "error": "draining",
+                            "state": self.state,
+                            "detail": req.error,
+                            "retry_after_s": ra,
+                        }
+                    ) + "\n", {"Retry-After": str(ra)}
+                return 500, json.dumps(
+                    {"error": "inference_failed", "detail": req.error}
+                ) + "\n", None
         return 200, json.dumps(
             {
                 "outputs": [np.asarray(r.result).tolist() for r in reqs],
                 "params_version": reqs[-1].params_version,
             }
-        ) + "\n"
+        ) + "\n", None
 
-    def _note_reject(self, e: OverloadRejected) -> None:
+    def _note_reject(
+        self, tenant: str, *, depth: int, bound: int, reason: str,
+        retry_after_s: int,
+    ) -> None:
         """``admission_reject`` events, debounced to one per tenant per
         second (a saturating tenant must not flood its own flight
-        recorder); the per-tenant counter in /status stays exact."""
+        recorder); the per-tenant counter in /status stays exact. Covers
+        both refusal flavors — ``reason="overload"`` (429, bounded queue
+        full) and ``reason="draining"/"replanning"`` (503, admission
+        closed) — and records the Retry-After the caller was answered
+        with (satellite 1)."""
         if self.events is None:
             return
         now = self._clock()
         # Handler threads race here: the (last_emit_t, count) read-modify-
         # write must be atomic or debounced counts drop rejects.
         with self._lock:
-            last_t, pent = self._reject_debounce.get(e.tenant, (0.0, 0))
+            last_t, pent = self._reject_debounce.get(tenant, (0.0, 0))
             pent += 1
             emit = now - last_t >= 1.0
-            self._reject_debounce[e.tenant] = (now, 0) if emit else (last_t, pent)
+            self._reject_debounce[tenant] = (now, 0) if emit else (last_t, pent)
         if emit:
             self.events.emit(
                 "admission_reject",
                 attempt=self.attempt,
-                tenant=e.tenant,
-                depth=e.depth,
-                bound=e.bound,
+                tenant=tenant,
+                depth=depth,
+                bound=bound,
+                reason=reason,
+                retry_after_s=int(retry_after_s),
                 rejects=pent,
                 rejected_total=int(sum(self.batcher.rejected.values())),
             )
+
+    # -- drain + live re-plan (ISSUE 20 tentpole) --------------------------
+
+    def drain(self, *, deadline_s: float = 10.0) -> dict:
+        """Stop admitting and flush in-flight micro-batches under a bounded
+        deadline. New requests get the typed 503 the moment the state
+        flips; queued requests keep dispatching (the loop flushes partial
+        batches immediately while draining); whatever is STILL queued when
+        the deadline passes is answered the same typed 503 — shed, never
+        dropped, never hung. A batch already executing at the deadline
+        always completes (its rows are answered 200: in-flight rows are
+        never dropped). Leaves the server in state ``"replanning"`` with
+        dispatch quiesced — callers resume via :meth:`drain_and_replan`
+        (the normal path) or :meth:`resume` (drain-only callers, tests)."""
+        deadline_s = float(deadline_s)
+        with self._lock:
+            if self.state != "serving":
+                raise RuntimeError(
+                    f"drain requested while already {self.state}"
+                )
+            self.state = "draining"
+            self._drain_deadline = self._clock() + deadline_s
+            self.drain_count += 1
+        t0 = self._clock()
+        deadline = self._drain_deadline
+        pending0 = self.batcher.pending()
+        if self.events is not None:
+            self.events.emit(
+                "drain_start",
+                attempt=self.attempt,
+                deadline_s=deadline_s,
+                pending=pending0,
+                params_version=self.engine.params_version,
+            )
+        # Bounded flush: the dispatch loop drains the queue; wait for it.
+        while self._clock() < deadline:
+            if self.batcher.pending() == 0 and self._inflight == 0:
+                break
+            self._stop.wait(0.001)
+        with self._lock:
+            self.state = "replanning"  # dispatch stops taking batches
+        # A batch the loop already took keeps running — let it finish.
+        while self._inflight > 0 and not self._stop.is_set():
+            self._stop.wait(0.001)
+        # Past-deadline: everything still queued gets the typed 503.
+        shed = 0
+        batch = self.batcher.next_batch(drain=True)
+        while batch is not None:
+            for req in batch.requests:
+                req.error = "drain deadline exceeded; replica re-planning"
+                req.error_code = 503
+                req.done.set()
+                shed += 1
+            batch = self.batcher.next_batch(drain=True)
+        with self._lock:
+            self.shed_total += shed
+        return {
+            "pending_at_drain": pending0,
+            "shed": shed,
+            "drain_ms": round((self._clock() - t0) * 1e3, 2),
+        }
+
+    def resume(self) -> None:
+        """Re-open admission (state back to ``"serving"``). Idempotent."""
+        with self._lock:
+            self.state = "serving"
+            self._drain_deadline = None
+
+    def drain_and_replan(
+        self, device_ids, *, deadline_s: float = 10.0
+    ) -> dict:
+        """The actuated-offer path: solve the elastic plan for the new
+        device set, drain under ``deadline_s``, rebuild the engine's
+        executables on the new mesh, warm the buckets, resume, and emit
+        ``replan_done``. Feasibility is checked BEFORE admission stops —
+        an infeasible target (unknown device id, a bucket not dividing
+        the new batch-shard extent) raises and leaves the replica serving
+        its old plan untouched, which is what the controller's revert
+        path relies on. On a post-drain failure the replica still resumes
+        on the old plan (the engine mutates nothing until its own
+        validation passes)."""
+        import jax
+
+        from distributed_training_pytorch_tpu.parallel import elastic
+        from distributed_training_pytorch_tpu.parallel import mesh as mesh_lib
+
+        ids = sorted({int(d) for d in device_ids})
+        if not ids:
+            raise ValueError("replan target names no devices")
+        by_id = {int(d.id): d for d in jax.devices()}
+        unknown = [d for d in ids if d not in by_id]
+        if unknown:
+            raise ValueError(
+                f"replan target names unknown device id(s) {unknown} "
+                f"(backend has {sorted(by_id)})"
+            )
+        old_axes = {
+            str(k): int(v) for k, v in self.engine.mesh.shape.items()
+        }
+        plan = elastic.replan(old_axes, len(ids))
+        new_extent = max(
+            1,
+            int(plan.new_axes.get("data", 1))
+            * int(plan.new_axes.get("fsdp", 1)),
+        )
+        bad = [b for b in self.engine.buckets if b % new_extent]
+        if bad:
+            raise ValueError(
+                f"buckets {bad} do not divide the re-planned batch-shard "
+                f"extent {new_extent} (plan {plan.new_axes}): refusing "
+                "before any admission stops"
+            )
+        new_mesh = plan.mesh_config.build([by_id[d] for d in ids])
+        t0 = self._clock()
+        summary = self.drain(deadline_s=deadline_s)
+        try:
+            self.engine.replan_onto(new_mesh)
+            warm = self._warm_row
+            if warm is not None:
+                # Recompile before taking traffic: the first post-replan
+                # request must not pay the compile.
+                self.engine.warmup(warm)
+        finally:
+            # Success or failure, admission re-opens: a failed replan left
+            # the engine on its old (validated-untouched) plan.
+            self.resume()
+        summary.update(
+            state=self.state,
+            device_ids=ids,
+            mesh_axes={
+                str(k): int(v) for k, v in self.engine.mesh.shape.items()
+            },
+            params_version=self.engine.params_version,
+            replan_ms=round((self._clock() - t0) * 1e3, 2),
+            plan_reason=plan.reason,
+        )
+        if self.events is not None:
+            self.events.emit(
+                "replan_done",
+                attempt=self.attempt,
+                from_mesh=old_axes,
+                to_mesh=summary["mesh_axes"],
+                device_ids=ids,
+                shed=summary["shed"],
+                replan_ms=summary["replan_ms"],
+                params_version=self.engine.params_version,
+                replans=self.engine.replan_count,
+                plan_reason=plan.reason,
+            )
+        return summary
+
+    # -- the offer handshake, replica side ---------------------------------
+
+    def handle_offer(self, body: dict) -> "tuple[int, str, dict | None]":
+        """The replica's half of the chip-offer handshake: the fleet
+        controller POSTs a freed chip; this replica accepts unless it is
+        already mid-drain or under SLO pressure — a replica breaching its
+        p99 must not take a drain + recompile window on top of the
+        breach. The decision is emitted (``offer_accept`` /
+        ``offer_decline``) so the handshake audits from the flight
+        recorder alone; accepting commits to nothing — the controller
+        actuates separately via ``POST /admin/replan``."""
+        chip = body.get("chip")
+        if not isinstance(chip, (int, float)):
+            return 400, json.dumps(
+                {"error": "bad_request", "detail": "no chip in offer"}
+            ) + "\n", None
+        chip = int(chip)
+        win = self.window.snapshot()
+        slo_ok = self._slo_ok(win)
+        state = self.state
+        if state != "serving":
+            decision, reason = "decline", f"replica is {state}"
+        elif slo_ok is False:
+            decision, reason = "decline", (
+                f"under SLO pressure: p99 {win['p99_ms']}ms > "
+                f"{self.slo_p99_ms}ms"
+            )
+        else:
+            decision, reason = "accept", "healthy and serving"
+        if self.events is not None:
+            self.events.emit(
+                "offer_accept" if decision == "accept" else "offer_decline",
+                attempt=self.attempt,
+                chip=chip,
+                reason=reason,
+                state=state,
+                slo_ok=slo_ok,
+                p99_ms=win["p99_ms"],
+                pending=self.batcher.pending(),
+            )
+        return 200, json.dumps(
+            {"decision": decision, "chip": chip, "reason": reason}
+        ) + "\n", None
+
+    def handle_replan(self, body: dict) -> "tuple[int, str, dict | None]":
+        """``POST /admin/replan``: actuate a drain + re-plan onto
+        ``body["device_ids"]``. 409 while a drain is already in progress;
+        400 (old plan untouched, still serving) when the target is
+        infeasible."""
+        device_ids = body.get("device_ids")
+        if not isinstance(device_ids, (list, tuple)) or not device_ids:
+            return 400, json.dumps(
+                {"error": "bad_request", "detail": "device_ids required"}
+            ) + "\n", None
+        deadline_s = float(body.get("deadline_s", 10.0))
+        if self.state != "serving":
+            ra = self.retry_after_s()
+            return 409, json.dumps(
+                {"error": "busy", "state": self.state, "retry_after_s": ra}
+            ) + "\n", {"Retry-After": str(ra)}
+        try:
+            summary = self.drain_and_replan(
+                device_ids, deadline_s=deadline_s
+            )
+        except Exception as e:  # noqa: BLE001 — typed refusal, old plan serving
+            return 400, json.dumps(
+                {
+                    "error": "replan_failed",
+                    "detail": f"{type(e).__name__}: {e}",
+                    "state": self.state,
+                }
+            ) + "\n", None
+        return 200, json.dumps(_jsonable(summary)) + "\n", None
 
     # -- dispatch loop -----------------------------------------------------
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
-            batch = self.batcher.next_batch()
+            state = self.state
+            if state == "replanning":
+                # Quiesced: the drain owns the queue now. Keep pulsing so
+                # the monitor sees a live (never dead) replica mid-replan.
+                self._maybe_pulse()
+                self._stop.wait(0.002)
+                continue
+            # While draining, flush partial batches immediately — waiting
+            # out max_delay_s inside a bounded drain window wastes it.
+            batch = self.batcher.next_batch(drain=(state == "draining"))
             if batch is None:
                 self._maybe_pulse()
                 # Sleep to the earliest of: the oldest request's flush
@@ -394,6 +749,8 @@ class InferenceServer:
                 bound = 0.002 if dl is None else max(0.0, min(dl - now, 0.002))
                 self._stop.wait(bound)
                 continue
+            with self._lock:
+                self._inflight += 1
             # Per-request validation cannot rule out one batch mixing row
             # shapes (two tenants posting different feature lengths), so
             # group by row signature and run each group on its own: the
@@ -403,29 +760,41 @@ class InferenceServer:
             for req in batch.requests:
                 row = np.asarray(req.payload)
                 groups.setdefault((row.shape, str(row.dtype)), []).append(req)
+                if self._warm_row is None:
+                    # Remembered for the post-replan warmup: the traffic's
+                    # own row signature is what the rebuilt executables
+                    # must be compiled for.
+                    self._warm_row = row
             n_done = 0
-            for reqs in groups.values():
-                try:
-                    payloads = np.stack([np.asarray(r.payload) for r in reqs])
-                    out, version = self.engine.predict(payloads)
-                except Exception as e:  # noqa: BLE001 — answered as 500s, server survives
-                    for req in reqs:
-                        req.error = f"{type(e).__name__}: {e}"
+            try:
+                for reqs in groups.values():
+                    try:
+                        payloads = np.stack(
+                            [np.asarray(r.payload) for r in reqs]
+                        )
+                        out, version = self.engine.predict(payloads)
+                    except Exception as e:  # noqa: BLE001 — answered as 500s, server survives
+                        for req in reqs:
+                            req.error = f"{type(e).__name__}: {e}"
+                            req.done.set()
+                        self._log(
+                            f"inference batch failed: {type(e).__name__}: {e}"
+                        )
+                        continue
+                    t_out = self._clock()
+                    for i, req in enumerate(reqs):
+                        req.result = out[i]
+                        req.params_version = version
+                        req.completed = t_out
+                        self.window.add(t_out, (t_out - req.arrival) * 1e3)
                         req.done.set()
-                    self._log(f"inference batch failed: {type(e).__name__}: {e}")
-                    continue
-                t_out = self._clock()
-                for i, req in enumerate(reqs):
-                    req.result = out[i]
-                    req.params_version = version
-                    req.completed = t_out
-                    self.window.add(t_out, (t_out - req.arrival) * 1e3)
-                    req.done.set()
-                n_done += len(reqs)
-            with self._lock:
-                self.requests_total += n_done
-                self._pulse_state["requests"] += n_done
-                self._pulse_state["batches"] += 1
+                    n_done += len(reqs)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self.requests_total += n_done
+                    self._pulse_state["requests"] += n_done
+                    self._pulse_state["batches"] += 1
             self._maybe_pulse()
         # Drain on shutdown: flush whatever is queued so no handler thread
         # is left blocked on a request that will never run.
@@ -454,6 +823,7 @@ class InferenceServer:
             )
             self._pulse_state.update(t=now, requests=0, batches=0)
         win = self.window.snapshot(now)
+        mesh_chips = max(1, int(self.engine.mesh.devices.size))
         self.events.emit(
             "request_batch",
             attempt=self.attempt,
@@ -461,12 +831,22 @@ class InferenceServer:
             batches=batches,
             interval_s=round(since, 3),
             qps=win["qps"],
+            # Per-MESH-chip, not per-backend-chip: the denominator is the
+            # replica's own device set, so absorbing an offered chip moves
+            # this number — the handshake's A/B metric (ISSUE 20).
+            qps_per_chip=round(win["qps"] / mesh_chips, 3),
+            mesh_chips=mesh_chips,
             p50_ms=win["p50_ms"],
             p99_ms=win["p99_ms"],
             slo_p99_ms=self.slo_p99_ms,
             slo_ok=self._slo_ok(win),
+            # The admission state rides the liveness pulse: a draining or
+            # re-planning replica keeps heartbeating, visibly mid-drain —
+            # the monitor must never read it as dead.
+            state=self.state,
             params_version=self.engine.params_version,
             rejected_total=int(sum(self.batcher.rejected.values())),
+            shed_total=self.shed_total,
         )
 
     def _slo_ok(self, win: dict) -> "bool | None":
@@ -500,6 +880,14 @@ class InferenceServer:
 
     def _swap_loop(self) -> None:
         while not self._stop.wait(self.swap_poll_s):
+            if self.state != "serving":
+                # Satellite 2 (ISSUE 20): a checkpoint commit landing
+                # mid-drain/re-plan must not flip params while the engine
+                # is being rebuilt — the watcher is gated behind the same
+                # state machine the drain owns, and simply re-arms on the
+                # first poll after the server resumes (the candidate is
+                # re-derived from disk, so nothing is missed).
+                continue
             try:
                 cand = self._swap_candidate()
             except Exception:  # noqa: BLE001 — a racing commit retries next poll
@@ -535,16 +923,24 @@ class InferenceServer:
         now = self._clock()
         win = self.window.snapshot(now)
         stats = self.batcher.stats()
-        import jax
-
-        n_chips = jax.device_count()
+        # Per-MESH-chip: the replica's own device set, so the handshake's
+        # before/after probe sees the absorbed chip in the denominator.
+        n_chips = max(1, int(self.engine.mesh.devices.size))
         return {
             "kind": "server",
             "port": self.port,
             "attempt": self.attempt,
+            "state": self.state,
             "uptime_s": round(now - self._started, 1) if self._started else 0.0,
             "params_version": self.engine.params_version,
             "swaps": self.engine.swap_count,
+            "replans": self.engine.replan_count,
+            "chips": n_chips,
+            "device_ids": sorted(
+                int(d.id) for d in self.engine.mesh.devices.flat
+            ),
+            "drains": self.drain_count,
+            "shed_total": self.shed_total,
             "requests_total": self.requests_total,
             "pending": stats["pending"],
             "rejected": stats["rejected"],

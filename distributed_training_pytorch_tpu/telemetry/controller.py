@@ -65,6 +65,7 @@ __all__ = [
     "Action",
     "ACTION_KINDS",
     "ControllerConfig",
+    "OfferHandshake",
     "RunPolicy",
 ]
 
@@ -76,9 +77,14 @@ ACTION_KINDS = (
     "revert",
     "give_up",
     "refuse",
-    # Mixed-fleet (ISSUE 18 satellite 1): when restart_excluding frees a
-    # chip from a trainer's mesh, the fleet controller offers it to a
-    # serving replica — advisory (recorded, audited), never a respawn.
+    # Mixed-fleet: when restart_excluding frees a chip from a trainer's
+    # mesh, the fleet controller offers it to a serving replica. Advisory
+    # in ISSUE 18; ACTUATED since ISSUE 20 — the replica accepts or
+    # declines over its /admin surface, an accepted offer drains,
+    # re-plans onto the freed chip, and is A/B-judged on before/after
+    # QPS-per-chip + p99 (kept-or-reverted, :class:`OfferHandshake`);
+    # a handshake that times out reverts and re-arms. Never a respawn of
+    # the serving replica's process.
     "offer_chip",
 )
 
@@ -114,6 +120,13 @@ class ControllerConfig:
     * ``ab_min_steady_s`` — steady wall the tuned attempt must accrue
       before it is judged (the first post-warmup sync's tiny denominator
       must not decide a revert).
+    * ``offer_timeout_s`` — wall budget for the whole actuated chip
+      offer (ISSUE 20): offer -> accept -> drain/re-plan -> serving
+      again. Past it the handshake reverts (the replica re-plans back,
+      or was never touched) and re-arms.
+    * ``offer_settle_s`` — post-re-plan settle window before the offer's
+      A/B judge reads the after-side probe (the first seconds after a
+      re-plan are warmup + queue flush, not steady state).
     """
 
     max_restarts: int = 3
@@ -124,6 +137,8 @@ class ControllerConfig:
     commit_delay_to: float = 0.0
     ab_noise_floor: float = 0.10
     ab_min_steady_s: float = 0.5
+    offer_timeout_s: float = 60.0
+    offer_settle_s: float = 2.0
 
 
 @dataclasses.dataclass
@@ -152,6 +167,166 @@ class Action:
             "params": dict(self.params),
             "evidence": list(self.evidence),
         }
+
+
+class OfferHandshake:
+    """The actuated chip offer's pure state machine (ISSUE 20 tentpole b).
+
+    Policy only, clock-injected, no sockets: ``scripts/fleet_controller.
+    py`` owns the transport (the replica's ``/admin/offer`` +
+    ``/admin/replan`` routes and ``/status`` probes) and drives this
+    object through it, exactly as :class:`RunPolicy` is driven by the
+    spawn/kill mechanism. States::
+
+        offered --decline--> declined                      (terminal)
+        offered --accept--> accepted --actuate--> settling
+        settling --judge--> kept | reverted                (terminal)
+        any non-terminal --deadline--> expired             (terminal,
+                                                 revert + re-arm)
+
+    The judge compares before/after ``/status`` probes on the two
+    metrics the tentpole names — QPS-per-chip and p99 — with the
+    chip-count correction that makes the comparison honest: absorbing a
+    chip under a fixed-rate open-loop load *halves* per-chip QPS by
+    construction, so the keep floor is the before-side throughput scaled
+    by ``before_chips / after_chips`` (what the same offered load yields
+    spread over more chips), noise-floored like every other A/B in the
+    controller. SLO health is primary: an after-side ``slo_ok=False``
+    reverts regardless of throughput arithmetic. Optional
+    ``steady_diff`` rows (run_compare's machinery, the PR 16 judge) ride
+    along as evidence when window fractions are available on both sides.
+    """
+
+    TERMINAL = ("declined", "kept", "reverted", "expired")
+
+    def __init__(
+        self,
+        chip: int,
+        *,
+        before: dict,
+        now: float,
+        timeout_s: float = 60.0,
+        settle_s: float = 2.0,
+    ):
+        self.chip = int(chip)
+        self.before = dict(before or {})
+        self.deadline = float(now) + float(timeout_s)
+        self.settle_s = float(settle_s)
+        self.state = "offered"
+        self.reason = ""
+        self.settle_until: "float | None" = None
+        self.actuation: dict = {}
+
+    @property
+    def done(self) -> bool:
+        return self.state in self.TERMINAL
+
+    def expired(self, now: float) -> bool:
+        """True (and the state flips to ``expired``) when the bounded
+        handshake wall ran out before a terminal state: the mechanism
+        must revert whatever was actuated and re-arm the offer."""
+        if not self.done and float(now) >= self.deadline:
+            self.reason = (
+                f"handshake timed out in state {self.state!r} before "
+                "completing — reverting and re-arming"
+            )
+            self.state = "expired"
+            return True
+        return False
+
+    def note_decision(self, decision: str, reason: str = "") -> None:
+        """Fold the replica's ``/admin/offer`` answer in."""
+        if self.state != "offered":
+            raise RuntimeError(f"decision arrived in state {self.state!r}")
+        if decision == "accept":
+            self.state = "accepted"
+        elif decision == "decline":
+            self.state = "declined"
+        else:
+            raise ValueError(f"unknown offer decision {decision!r}")
+        self.reason = reason
+
+    def note_actuated(self, summary: dict, *, now: float) -> None:
+        """The replica drained, re-planned and resumed (``/admin/replan``
+        returned 200): start the settle window the judge waits out."""
+        if self.state != "accepted":
+            raise RuntimeError(f"actuation arrived in state {self.state!r}")
+        self.state = "settling"
+        self.actuation = dict(summary or {})
+        self.settle_until = float(now) + self.settle_s
+
+    def ready_to_judge(self, now: float) -> bool:
+        return (
+            self.state == "settling"
+            and self.settle_until is not None
+            and float(now) >= self.settle_until
+        )
+
+    def judge(
+        self, after: dict, *, noise_floor: float = 0.10, steady_diff=None
+    ) -> "tuple[str, list]":
+        """The offer's A/B verdict from before/after ``/status`` probes.
+        Returns ``("keep"|"revert", evidence_rows)`` and moves to the
+        matching terminal state. See the class doc for the chip-scaled
+        throughput floor; ``steady_diff(before_fractions, after_fractions,
+        noise_floor=...)`` contributes evidence rows when both probes
+        carry window fractions (same injection seam as RunPolicy's)."""
+        if self.state != "settling":
+            raise RuntimeError(f"judge called in state {self.state!r}")
+        after = dict(after or {})
+        before_qpc = float(self.before.get("qps_per_chip") or 0.0)
+        after_qpc = float(after.get("qps_per_chip") or 0.0)
+        before_chips = max(1, int(self.before.get("chips") or 1))
+        after_chips = max(1, int(after.get("chips") or before_chips))
+        # The same offered load spread over the grown device set: the
+        # honest floor a fixed-rate client leaves an absorbing replica.
+        expected = before_qpc * (before_chips / after_chips)
+        floor = expected * (1.0 - float(noise_floor))
+        slo_bad = after.get("slo_ok") is False
+        evidence = [
+            {
+                "metric": "qps_per_chip",
+                "before": round(before_qpc, 3),
+                "after": round(after_qpc, 3),
+                "expected_floor": round(floor, 3),
+                "chips": [before_chips, after_chips],
+            },
+            {
+                "metric": "p99_ms",
+                "before": self.before.get("p99_ms"),
+                "after": after.get("p99_ms"),
+            },
+            {
+                "metric": "slo_ok",
+                "before": self.before.get("slo_ok"),
+                "after": after.get("slo_ok"),
+            },
+        ]
+        if steady_diff is not None:
+            bf = self.before.get("steady_fractions")
+            af = after.get("steady_fractions")
+            if bf and af:
+                diff = steady_diff(bf, af, noise_floor=noise_floor)
+                evidence += [
+                    r.to_dict() if hasattr(r, "to_dict") else dict(r)
+                    for r in (diff.get("rows") or [])[:4]
+                ]
+        keep = not slo_bad and after_qpc >= floor
+        if keep:
+            self.state = "kept"
+            self.reason = (
+                f"qps/chip {after_qpc:.3f} >= floor {floor:.3f} "
+                f"({before_chips}->{after_chips} chips) and SLO healthy"
+            )
+            return "keep", evidence
+        self.state = "reverted"
+        self.reason = (
+            "SLO breached after absorb"
+            if slo_bad
+            else f"qps/chip {after_qpc:.3f} < floor {floor:.3f} "
+            f"({before_chips}->{after_chips} chips)"
+        )
+        return "revert", evidence
 
 
 def _steady_seconds(fractions_or_seconds: dict | None) -> float:

@@ -78,7 +78,21 @@ one checkpoint hot-swap under load (``checkpoint`` name,
 ``from_version``/``to_version``, ``swap_ms``, ``pending_requests``);
 ``admission_reject`` — a typed overload rejection, debounced to one
 record per tenant per second (``tenant``, ``depth`` vs ``bound``,
-``rejects`` since the last record)), and the streaming-data layer's records
+``rejects`` since the last record; since schema 8 also ``reason``
+``overload``|``draining``|``replanning`` and the ``retry_after_s`` the
+refused caller was told — the backpressure signal, derived from queue
+depth and the drain deadline)), and the actuated-handshake records
+(ISSUE 20, emitted by ``serving/server.py``: ``offer_accept`` /
+``offer_decline`` — a replica's decision on an offered chip
+(``chip``, ``reason``, its ``state``/``slo_ok``/``p99_ms``/``pending``
+at decision time — a replica under SLO pressure declines);
+``drain_start`` — admission stops for a drain (``deadline_s``,
+``pending``, ``params_version``); ``replan_done`` — the replica is
+serving again on the re-planned device set (``from_mesh``/``to_mesh``
+axes, ``device_ids``, requests ``shed`` past the drain deadline,
+``replan_ms``, the unchanged ``params_version``, cumulative
+``replans``, the elastic solver's ``plan_reason``)), and the
+streaming-data layer's records
 (ISSUE 19, emitted by the Trainer for any loader speaking the
 reader-state surface (``data/streaming``): ``shard_assignment`` — one per
 attempt, on start and on every elastic resume (the assignment ``version``
@@ -172,8 +186,14 @@ __all__ = [
 #       (one per attempt: the per-host split of the deterministic global
 #       record sequence — version fingerprint, row range, batch extent,
 #       resume batch) and ``data_reader_state`` (one per checkpoint save:
-#       the epoch/cursor/seed a resume will consume from).
-SCHEMA_VERSION = 7
+#       the epoch/cursor/seed a resume will consume from);
+#   8 — the actuated-handshake vocabulary (ISSUE 20): ``offer_accept`` /
+#       ``offer_decline`` (a serving replica's decision on an offered
+#       chip), ``drain_start`` / ``replan_done`` (the graceful-drain +
+#       live-re-plan cycle), ``reason``/``retry_after_s`` on
+#       ``admission_reject``, and ``state``/``qps_per_chip``/
+#       ``mesh_chips``/``shed_total`` on the ``request_batch`` pulse.
+SCHEMA_VERSION = 8
 
 
 def _jsonable(value: Any) -> Any:

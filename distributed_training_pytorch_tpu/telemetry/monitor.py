@@ -91,6 +91,14 @@ _PROGRESS_KINDS = (
     "serve_start",
     "request_batch",
     "hot_swap",
+    # The actuated-handshake vocabulary (ISSUE 20): the dispatch loop
+    # keeps pulsing through a drain/re-plan, and these are emitted by
+    # that same (live) loop's machinery — a replica mid-drain must read
+    # as draining, never as dead or stale.
+    "drain_start",
+    "replan_done",
+    "offer_accept",
+    "offer_decline",
 )
 
 # Verdicts alerted on transition (score crossing 1.0). data_bound /
@@ -147,7 +155,10 @@ class MonitorStatus:
     """One poll's answer: liveness + the doctor's online diagnosis."""
 
     run_dir: str
-    status: str  # waiting | training | serving | stale_heartbeat | dead | finished
+    # waiting | training | serving | draining | replanning | stale_heartbeat
+    # | dead | finished (draining/replanning: a serve replica mid ISSUE 20
+    # drain/re-plan — still alive, deliberately not admitting)
+    status: str
     verdict: str  # liveness kind when stale/dead; doctor's top verdict for
     # trainers; healthy|slo_breach for servers (ISSUE 18 satellite 2)
     diagnosis: "doctor_lib.Diagnosis | None"
@@ -391,11 +402,26 @@ class RunMonitor:
                     "slo_p99_ms",
                     "params_version",
                     "rejected_total",
+                    # ISSUE 20: the pulse carries the admission state and
+                    # the per-MESH-chip throughput the A/B judge reads.
+                    "state",
+                    "qps_per_chip",
+                    "mesh_chips",
+                    "shed_total",
                 ):
                     if key in rec:
                         self._serve[key] = rec[key]
             elif kind == "hot_swap" and rec.get("to_version") is not None:
                 self._serve["params_version"] = rec["to_version"]
+            elif kind == "drain_start":
+                # Admission just stopped: even if the next pulse is a
+                # second out, status must already read "draining", never
+                # "dead" (ISSUE 20 acceptance).
+                self._serve["state"] = "draining"
+            elif kind == "replan_done":
+                self._serve["state"] = "serving"
+                if rec.get("device_ids"):
+                    self._serve["mesh_chips"] = len(rec["device_ids"])
             for key in ("epoch", "step_in_epoch"):
                 if rec.get(key) is not None:
                     self.headline[key] = rec[key]
@@ -578,7 +604,14 @@ class RunMonitor:
             # liveness + the SLO flag its request_batch pulse carries.
             diagnosis = None
             if status == "training":
-                status = "serving"
+                # The replica reports its own admission state (ISSUE 20):
+                # a live drain/re-plan reads as that state, not as a
+                # generic "serving" — and because the dispatch loop keeps
+                # pulsing through both, never as "dead".
+                state = self._serve.get("state")
+                status = (
+                    state if state in ("draining", "replanning") else "serving"
+                )
         else:
             diagnosis = doctor_lib.diagnose(sig) if self._seen_any else None
         fractions = doctor_lib.steady_fractions(sig.goodput_seconds or {})

@@ -80,6 +80,38 @@ ELASTIC_TOL = 1e-4
 _SPEC_TOKEN = {"data": "dp", "fsdp": "fsdp", "tensor": "tp"}
 
 
+def _http_get_json(url: str, timeout: float = 5.0):
+    """``(status, parsed_body)`` from a GET — the handshake's probe
+    transport (stdlib only, same as the server's own surface)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode() or "{}")
+
+
+def _http_post_json(url: str, payload: dict, timeout: float = 30.0):
+    """``(status, parsed_body)`` from a JSON POST. 4xx/5xx are *data*
+    here (the replica's typed refusals carry bodies the handshake must
+    read), not exceptions — only transport failures raise."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            return e.code, json.loads(body or "{}")
+        except ValueError:
+            return e.code, {"raw": body}
+
+
 def axes_to_spec(axes: dict) -> str:
     """Render a re-planned axes dict back into the ``--mesh`` grammar the
     child parses (``{"data": 2, "fsdp": 2}`` -> ``"dp2fsdp2"``)."""
@@ -262,6 +294,11 @@ class RunSpec:
     name: str
     run_dir: str
     kind: str = "train"  # "train" | "serve" — a mixed fleet (ISSUE 18)
+    # A serving replica's admin port (ISSUE 20): non-zero turns the chip
+    # offer from an advisory record into the actuated offer -> accept ->
+    # drain/re-plan -> A/B-judged handshake over /admin/offer + /admin/
+    # replan. 0 keeps the ISSUE 18 advisory-record behavior.
+    port: int = 0
     cmd: list | None = None
     adopt: bool = False  # no spawn at start; supervise whatever writes the log
     final: str = ""
@@ -331,6 +368,12 @@ class FleetController:
         self.events = event_log
         self.interval = float(interval)
         self._clock = clock
+        # The actuated-offer seams (ISSUE 20), attribute-injectable so the
+        # handshake is testable without sockets or wall-clock sleeps.
+        self._steady_diff = steady_diff
+        self._sleep = time.sleep
+        self._http_get = _http_get_json
+        self._http_post = _http_post_json
         self.runs: dict[str, SupervisedRun] = {}
         for spec in specs:
             mon = monitor_lib.RunMonitor(
@@ -420,17 +463,40 @@ class FleetController:
             **action.event_fields(),
         )
 
+    def _emit_serve_action(self, srv: SupervisedRun, act) -> None:
+        """Record one serving-side handshake action: appended to the
+        replica's action ledger and emitted as a ``controller_action``
+        audit record. Deliberately NOT routed through the replica's
+        RunPolicy — offer actuation never respawns the server process, so
+        it must not consume restart budget or start a backoff window."""
+        srv.actions.append(act)
+        st = srv.last_status
+        self.events.emit(
+            "controller_action",
+            run=srv.spec.name,
+            run_dir=srv.spec.run_dir,
+            attempt=st.attempt if st is not None else None,
+            status=st.status if st is not None else "unknown",
+            verdict=st.verdict if st is not None else "unknown",
+            restarts_used=srv.policy.restarts_used,
+            max_restarts=self.config.max_restarts,
+            **act.event_fields(),
+        )
+
     def _offer_freed_chip(self, run: SupervisedRun, action, status) -> None:
-        """Mixed-fleet accounting (ISSUE 18 satellite 1): a chip a trainer's
-        ``restart_excluding`` just dropped from its mesh is not returned to
-        the scheduler — it is OFFERED to a serving replica in the same
-        fleet, as one advisory ``offer_chip`` controller_action per serving
-        run. A straggler chip too slow for a lockstep collective is often
-        fine for latency-bound inference (no per-step barrier to hold
-        hostage); the offer record carries that provenance so the operator
-        (or a capacity layer) can accept or decline with the evidence in
-        hand. Advisory only: the controller never respawns a healthy
-        server."""
+        """Mixed-fleet accounting: a chip a trainer's ``restart_excluding``
+        just dropped from its mesh is not returned to the scheduler — it
+        is OFFERED to a serving replica in the same fleet. A straggler
+        chip too slow for a lockstep collective is often fine for
+        latency-bound inference (no per-step barrier to hold hostage).
+
+        Replicas with a known admin ``port`` get the ACTUATED handshake
+        (ISSUE 20): offer -> accept/decline over ``/admin/offer``, an
+        accepted offer drains/re-plans over ``/admin/replan``, and the
+        absorb is A/B-judged on before/after QPS-per-chip + p99 — kept or
+        reverted like the PR 16 bounded tunes. Port-less replicas keep
+        the ISSUE 18 advisory record (recorded, audited, not executed).
+        Either way the controller never respawns a healthy server."""
         from distributed_training_pytorch_tpu.telemetry.controller import Action
 
         chip = action.params.get("exclude_chip")
@@ -441,6 +507,9 @@ class FleetController:
             if r.spec.kind == "serve" and r.spec.name != run.spec.name
         ]
         for srv in servers:
+            if srv.spec.port:
+                self._actuate_offer(run, srv, int(chip), action)
+                continue
             offer = Action(
                 kind="offer_chip",
                 reason=action.reason,
@@ -456,19 +525,179 @@ class FleetController:
                 },
                 evidence=list(action.evidence),
             )
-            srv.actions.append(offer)
-            st = srv.last_status
-            self.events.emit(
-                "controller_action",
-                run=srv.spec.name,
-                run_dir=srv.spec.run_dir,
-                attempt=st.attempt if st is not None else None,
-                status=st.status if st is not None else "unknown",
-                verdict=st.verdict if st is not None else "unknown",
-                restarts_used=srv.policy.restarts_used,
-                max_restarts=self.config.max_restarts,
-                **offer.event_fields(),
+            self._emit_serve_action(srv, offer)
+
+    def _replan_back(self, base: str, old_ids: list) -> bool:
+        """Best-effort restore of the pre-offer device set — the physical
+        half of the handshake's revert. Failure here is tolerable by
+        design: the replica pre-validates every re-plan before touching
+        admission, so a replica we cannot reach is either dead (its own
+        monitor surfaces that) or still serving *some* valid plan."""
+        try:
+            code, _ = self._http_post(
+                base + "/admin/replan",
+                {"device_ids": list(old_ids), "deadline_s": 10.0},
+                timeout=60.0,
             )
+            return code == 200
+        except Exception:
+            return False
+
+    def _actuate_offer(self, run: SupervisedRun, srv: SupervisedRun,
+                       chip: int, action) -> None:
+        """Drive one actuated chip offer end to end (ISSUE 20 tentpole b):
+        the mechanism around :class:`telemetry.controller.OfferHandshake`.
+        Every terminal path leaves an audit record on the serving run —
+        ``offer_chip`` then ``keep`` (absorbed, judged better-or-equal),
+        or ``revert`` (judged against / replica refused / handshake timed
+        out, the latter two re-armed for a future offer). The replica's
+        own flight recorder carries the matching ``offer_accept`` /
+        ``offer_decline`` / ``drain_start`` / ``replan_done`` records."""
+        from distributed_training_pytorch_tpu.telemetry.controller import (
+            Action,
+            OfferHandshake,
+        )
+
+        base = f"http://127.0.0.1:{srv.spec.port}"
+        cfg = self.config
+        try:
+            _, before = self._http_get(base + "/status", timeout=5.0)
+        except Exception as e:
+            before = {"probe_error": f"{type(e).__name__}: {e}"}
+        hs = OfferHandshake(
+            chip,
+            before=before,
+            now=self._clock(),
+            timeout_s=float(getattr(cfg, "offer_timeout_s", 60.0)),
+            settle_s=float(getattr(cfg, "offer_settle_s", 2.0)),
+        )
+        self._emit_serve_action(srv, Action(
+            kind="offer_chip",
+            reason=action.reason,
+            message=(
+                f"chip {chip} freed from {run.spec.name}'s mesh by "
+                f"restart_excluding; offered to serving replica "
+                f"{srv.spec.name} for actuation"
+            ),
+            params={
+                "chip": int(chip),
+                "from_run": run.spec.name,
+                "to_run": srv.spec.name,
+                "port": int(srv.spec.port),
+                "actuated": True,
+            },
+            evidence=list(action.evidence),
+        ))
+
+        def fail(reason: str, detail: str, *, rearm: bool = True,
+                 evidence: list = ()) -> None:
+            self._emit_serve_action(srv, Action(
+                kind="revert",
+                reason=reason,
+                message=(
+                    f"offer of chip {chip} to {srv.spec.name}: {detail}"
+                    + (" — re-armed" if rearm else "")
+                ),
+                params={
+                    "chip": int(chip),
+                    "to_run": srv.spec.name,
+                    "rearmed": bool(rearm),
+                    "handshake_state": hs.state,
+                },
+                evidence=list(evidence),
+            ))
+
+        # 1) offer -> the replica's accept/decline (its own SLO call).
+        try:
+            code, body = self._http_post(
+                base + "/admin/offer", {"chip": int(chip)}, timeout=10.0
+            )
+        except Exception as e:
+            fail("offer_timeout",
+                 f"offer transport failed ({type(e).__name__}: {e})")
+            return
+        if code != 200 or body.get("decision") not in ("accept", "decline"):
+            fail("offer_timeout", f"offer answered {code}: {body}")
+            return
+        hs.note_decision(body["decision"], body.get("reason", ""))
+        if hs.state == "declined":
+            # The decline is the replica's own flight-recorder record
+            # (offer_decline, with its SLO evidence); nothing was
+            # actuated, so there is nothing to revert or record here.
+            return
+
+        # 2) actuate: drain + re-plan onto the grown device set.
+        old_ids = sorted(int(d) for d in (before.get("device_ids") or []))
+        if not old_ids:
+            fail("replan_failed",
+                 "replica reported no device_ids to grow from")
+            return
+        new_ids = sorted(set(old_ids) | {int(chip)})
+        wall_left = max(1.0, hs.deadline - self._clock())
+        try:
+            code, summary = self._http_post(
+                base + "/admin/replan",
+                {"device_ids": new_ids,
+                 "deadline_s": min(10.0, wall_left)},
+                timeout=wall_left,
+            )
+        except Exception as e:
+            # Transport died mid-actuation: the replica may or may not
+            # have re-planned — push it back to the known-good set.
+            self._replan_back(base, old_ids)
+            fail("offer_timeout",
+                 f"replan transport failed ({type(e).__name__}: {e})")
+            return
+        if code != 200:
+            # The replica pre-validates before touching admission: a
+            # refused re-plan left it serving the OLD plan untouched.
+            fail("replan_failed",
+                 f"replica refused the re-plan ({code}: {summary})")
+            return
+        hs.note_actuated(summary, now=self._clock())
+
+        # 3) settle, then judge on the after-side probe.
+        while not hs.ready_to_judge(self._clock()):
+            if hs.expired(self._clock()):
+                self._replan_back(base, old_ids)
+                fail("offer_timeout", hs.reason)
+                return
+            self._sleep(0.05)
+        try:
+            _, after = self._http_get(base + "/status", timeout=5.0)
+        except Exception as e:
+            self._replan_back(base, old_ids)
+            fail("offer_timeout",
+                 f"after-probe failed ({type(e).__name__}: {e})")
+            return
+        verdict, evidence = hs.judge(
+            after,
+            noise_floor=float(getattr(cfg, "ab_noise_floor", 0.10)),
+            steady_diff=self._steady_diff,
+        )
+        if verdict == "keep":
+            self._emit_serve_action(srv, Action(
+                kind="keep",
+                reason="offer_chip",
+                message=(
+                    f"chip {chip} absorbed by {srv.spec.name} and kept: "
+                    f"{hs.reason}"
+                ),
+                params={
+                    "chip": int(chip),
+                    "to_run": srv.spec.name,
+                    "device_ids": new_ids,
+                },
+                evidence=evidence,
+            ))
+            return
+        self._replan_back(base, old_ids)
+        fail(
+            "offer_chip",
+            f"A/B judged against the absorb: {hs.reason}",
+            rearm=False,
+            evidence=evidence,
+        )
 
     def _replan_spec(self, spec: RunSpec, action) -> None:
         """Fold the policy's exclusion into the spawn spec through the

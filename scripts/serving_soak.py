@@ -27,6 +27,24 @@ Open-loop means arrivals do not wait for completions: a slow server meets
 a growing queue, exactly like production. ``--quick`` shortens the traffic
 windows for CI; the assertions are identical.
 
+``--actuate`` (ISSUE 20) runs the actuated-offer legs instead — the
+self-healing drain/re-plan path end to end, against REAL subprocess
+replicas driven by the REAL fleet controller:
+
+* **actuate** — a chip freed by a trainer's ``restart_excluding`` is
+  offered to a dp1 replica over ``/admin/offer``; the accept drains,
+  re-plans onto dp2, and the A/B judge keeps the absorb. Asserted: ZERO
+  failed requests across the drain window (RetryClient riding the
+  Retry-After headers), response bytes bit-identical across the re-plan,
+  the ``offer_chip -> offer_accept -> drain_start -> replan_done`` audit
+  chain in wall-clock order, keep evidenced by QPS-per-chip, and a
+  monitor polling throughout that NEVER reads the draining replica as
+  dead.
+* **actuate_decline** — a replica under SLO pressure declines; nothing
+  is drained, nothing is re-planned, the decline is audited.
+* **actuate_timeout** — a handshake that cannot reach its replica
+  reverts cleanly and re-arms the offer (a second offer still fires).
+
 Exit 0 = every leg passed. Any failure prints ``serving_soak: FAIL`` lines
 and exits 1.
 """
@@ -174,7 +192,7 @@ SEQ_LEN = 16
 LM_VOCAB = 64
 
 
-def _lm_engine(mesh, seed: int):
+def _lm_engine(mesh, seed: int, buckets=(1, 2, 4, 8)):
     import jax
     import jax.numpy as jnp
 
@@ -189,7 +207,7 @@ def _lm_engine(mesh, seed: int):
     def apply_fn(p, tokens):
         return model.apply({"params": p}, tokens)
 
-    engine = InferEngine(apply_fn, mesh, buckets=(1, 2, 4, 8))
+    engine = InferEngine(apply_fn, mesh, buckets=tuple(buckets))
     return engine, params, apply_fn
 
 
@@ -501,16 +519,27 @@ def leg_hot_swap(run_root: str, args) -> None:
 def serve_worker(args) -> int:
     """Child mode: one serving replica on a FIXED port, deterministic
     params from ``--seed`` (so a respawn is bit-identical), supervised via
-    its run_dir flight recorder. Runs until SIGTERM."""
-    compat.force_host_devices(2)
+    its run_dir flight recorder. Runs until SIGTERM.
+
+    ``--mesh-spec``/``--device-ids``/``--device-count``/``--buckets``
+    (ISSUE 20) let the actuate legs start a replica on a SUBSET of the
+    host's virtual devices (e.g. dp1 on chip 0 of 2) so the actuated
+    offer has a real spare chip to grow onto."""
+    compat.force_host_devices(args.device_count)
     import jax
     import numpy as np
 
     from distributed_training_pytorch_tpu.parallel.mesh import mesh_config_from_spec
     from distributed_training_pytorch_tpu.serving import InferenceServer, MicroBatcher
 
-    mesh = mesh_config_from_spec("tp2").build(jax.devices()[:2])
-    engine, params, _ = _lm_engine(mesh, seed=args.seed)
+    if args.device_ids:
+        want = {int(x) for x in args.device_ids.split(",")}
+        devs = [d for d in jax.devices() if d.id in want]
+    else:
+        devs = jax.devices()[:2]
+    mesh = mesh_config_from_spec(args.mesh_spec).build(devs)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine, params, _ = _lm_engine(mesh, seed=args.seed, buckets=buckets)
     engine.swap_params(params, version=f"seed{args.seed}")
     engine.warmup(np.zeros((SEQ_LEN,), np.int32))
     server = InferenceServer(
@@ -519,6 +548,7 @@ def serve_worker(args) -> int:
         port=args.port,
         run_dir=args.run_dir,
         slo_p99_ms=args.slo_p99_ms,
+        window_s=args.window_s,
         pulse_every_s=0.5,
         input_dtype="int32",
     ).start()
@@ -779,6 +809,383 @@ def leg_neutrality(run_root: str, args) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --actuate legs (ISSUE 20): the actuated chip offer, end to end
+# ---------------------------------------------------------------------------
+
+
+def _serve_spec(fc, run_dir: str, port: int, args, *, slo_p99_ms: float,
+                mesh_spec: str = "dp1", device_ids: str = "0",
+                buckets: str = "2,4,8", window_s: float = 3.0):
+    """A supervised serving-replica RunSpec whose admin ``port`` is known
+    to the controller — the thing that turns offer_chip from an advisory
+    record into the actuated handshake. The short latency window matches
+    the judge's settle: by judge time the drain gap has rolled out and
+    the after-probe reads steady post-absorb traffic, not the gap."""
+    return fc.RunSpec(
+        name="server0",
+        run_dir=run_dir,
+        kind="serve",
+        port=port,
+        cmd=[
+            sys.executable,
+            os.path.abspath(__file__),
+            "--serve-worker",
+            "--run-dir", run_dir,
+            "--port", str(port),
+            "--seed", str(args.seed),
+            "--slo-p99-ms", str(slo_p99_ms),
+            "--mesh-spec", mesh_spec,
+            "--device-ids", device_ids,
+            "--device-count", "2",
+            "--buckets", buckets,
+            "--window-s", str(window_s),
+        ],
+    )
+
+
+def _freed_chip_action(chip: int):
+    """The trainer-side trigger: what restart_excluding leaves behind."""
+    from distributed_training_pytorch_tpu.telemetry.controller import Action
+
+    return Action(
+        kind="restart_excluding",
+        reason="straggler",
+        params={"exclude_chip": int(chip)},
+        evidence=[{"metric": "straggler_ratio", "value": 3.2}],
+    )
+
+
+def _start_fleet(fc, specs, run_root: str, args, *, settle_s: float = 1.0):
+    from distributed_training_pytorch_tpu.telemetry.controller import ControllerConfig
+    from distributed_training_pytorch_tpu.telemetry.events import EventLog
+    from distributed_training_pytorch_tpu.telemetry.monitor import AlertConfig
+
+    ctl_log = os.path.join(run_root, "controller_events.jsonl")
+    ctl = fc.FleetController(
+        specs,
+        # A generous noise floor: the soak judges the MECHANISM (the
+        # chip-scaled floor, the evidence chain), not CPU-emulation perf —
+        # a drain pause inside the QPS window must not flake the verdict.
+        config=ControllerConfig(
+            max_restarts=2, backoff_s=0.1, confirm_polls=1,
+            ab_noise_floor=0.5, offer_timeout_s=120.0,
+            offer_settle_s=settle_s,
+        ),
+        monitor_config=AlertConfig(stale_after_s=60.0, dead_after_s=120.0),
+        event_log=EventLog(ctl_log, process_index=0),
+        interval=0.2,
+    )
+    ctl.start()
+    return ctl, ctl_log
+
+
+def leg_actuate(run_root: str, args) -> None:
+    """The tentpole end to end: offer -> accept -> drain -> re-plan dp1->dp2
+    -> settle -> A/B keep, with RetryClient traffic riding the 503s and a
+    monitor that must never read the draining replica as dead."""
+    import types
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet_controller as fc
+
+    from distributed_training_pytorch_tpu.serving.client import (
+        RetriesExhausted,
+        RetryClient,
+    )
+    from distributed_training_pytorch_tpu.telemetry.events import (
+        read_events,
+        resolve_events_path,
+    )
+    from distributed_training_pytorch_tpu.telemetry.monitor import (
+        AlertConfig,
+        RunMonitor,
+    )
+
+    leg = "actuate"
+    run_dir = os.path.join(run_root, "server0")
+    os.makedirs(run_dir, exist_ok=True)
+    port = _free_port()
+    trainer = fc.RunSpec(
+        name="trainer0", run_dir=os.path.join(run_root, "trainer0"),
+        adopt=True, device_ids=(0, 1), mesh="fsdp2",
+    )
+    os.makedirs(trainer.run_dir, exist_ok=True)
+    server = _serve_spec(fc, run_dir, port, args, slo_p99_ms=args.slo_p99_ms)
+    # Settle past the replica's 3 s QPS window: the judge's after-probe
+    # must read post-absorb steady state, not the drain gap.
+    ctl, ctl_log = _start_fleet(fc, [trainer, server], run_root, args,
+                                settle_s=4.0)
+    try:
+        row = (np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB).tolist()
+        body_before = _wait_serving(port, row)
+
+        stop = threading.Event()
+        failures: list = []
+        ok_count = [0]
+        dead_sightings: list = []
+        cli = RetryClient(max_attempts=8, base_delay_s=0.05,
+                          max_delay_s=2.0, timeout_s=30.0)
+        req_threads: list = []
+
+        def one_request(r) -> None:
+            # Through the retry helper: a 503 during the drain window is
+            # the CONTRACT (Retry-After + backoff), not a failure. Only
+            # an exhausted retry budget or a non-200 terminal answer
+            # counts as failed.
+            try:
+                code, _body = cli.post_json(
+                    f"http://127.0.0.1:{port}/predict",
+                    {"tenant": "load", "inputs": [r]},
+                )
+                if code != 200:
+                    failures.append(("status", code))
+                else:
+                    ok_count[0] += 1
+            except RetriesExhausted as e:
+                failures.append(("exhausted", e.attempts[-3:]))
+            except Exception as e:  # noqa: BLE001
+                failures.append(("transport", repr(e)))
+
+        def hammer() -> None:
+            # OPEN-LOOP arrivals: a new request every 20 ms regardless of
+            # completions. A caller stuck honoring a long drain
+            # Retry-After must not starve the after-window — fresh
+            # arrivals keep probing, exactly like independent clients.
+            rng = np.random.default_rng(args.seed + 5)
+            while not stop.is_set():
+                r = rng.integers(0, LM_VOCAB, size=(SEQ_LEN,)).tolist()
+                th = threading.Thread(target=one_request, args=(r,),
+                                      daemon=True)
+                th.start()
+                req_threads.append(th)
+                time.sleep(0.02)
+
+        def watch_monitor() -> None:
+            # The tentpole's monitor clause: a draining replica is
+            # DRAINING, never dead — polled live across the whole
+            # handshake, not reconstructed afterwards.
+            mon = RunMonitor(run_dir, AlertConfig(stale_after_s=60.0,
+                                                  dead_after_s=120.0))
+            while not stop.is_set():
+                st = mon.poll()
+                if st.status == "dead":
+                    dead_sightings.append(st.verdict)
+                time.sleep(0.1)
+
+        threads = [threading.Thread(target=hammer, daemon=True),
+                   threading.Thread(target=watch_monitor, daemon=True)]
+        for th in threads:
+            th.start()
+        time.sleep(3.5)  # fill the replica's QPS window at steady rate
+
+        status = types.SimpleNamespace(attempt=1, status="training",
+                                       verdict="straggler")
+        ctl._offer_freed_chip(
+            ctl.runs["trainer0"], _freed_chip_action(1), status
+        )
+        time.sleep(0.5)  # post-verdict traffic across the grown mesh
+        stop.set()
+        for th in threads:
+            th.join(timeout=30.0)
+        for th in req_threads:  # every in-flight retry must resolve
+            th.join(timeout=30.0)
+        _check(not any(th.is_alive() for th in req_threads), leg,
+               "a retrying request never resolved (hang)")
+
+        _check(not failures, leg,
+               f"{len(failures)} failed requests, first: {failures[:1]}")
+        _check(ok_count[0] >= 10, leg,
+               f"only {ok_count[0]} requests completed")
+        _check(not dead_sightings, leg,
+               f"monitor read the replica as dead: {dead_sightings[:1]}")
+
+        # The absorb happened and was KEPT: dp1 -> dp2, same params.
+        st = _get_json(port, "/status")
+        _check(st["state"] == "serving", leg, f"end state {st['state']}")
+        _check(st["chips"] == 2 and st["device_ids"] == [0, 1], leg,
+               f"mesh did not grow: {st['chips']} chips {st['device_ids']}")
+        _check(st["replans"] == 1 and st["drains"] == 1, leg,
+               f"replans={st['replans']} drains={st['drains']}")
+        body_after = _post(port, {"tenant": "probe", "inputs": [row]})[1]
+        _check(body_after == body_before, leg,
+               "response bytes changed across the re-plan (same params!)")
+
+        acts = [a for a in ctl.runs["server0"].actions]
+        kinds = [a.kind for a in acts]
+        _check(kinds == ["offer_chip", "keep"], leg,
+               f"controller actions {kinds} (wanted offer_chip, keep)")
+        _check(acts[0].params.get("actuated") is True, leg,
+               "offer_chip was advisory, not actuated")
+        qpc = [e for e in acts[1].evidence
+               if e.get("metric") == "qps_per_chip"]
+        _check(bool(qpc) and qpc[0]["after"] >= qpc[0]["expected_floor"],
+               leg, f"keep not evidenced by qps_per_chip: {acts[1].evidence}")
+
+        # The audit chain, in wall-clock order across BOTH logs:
+        # controller's offer_chip precedes the replica's accept -> drain
+        # -> replan_done.
+        replica = [r for r in read_events(resolve_events_path(run_dir))
+                   if r.get("event") in ("offer_accept", "offer_decline",
+                                         "drain_start", "replan_done")]
+        _check([r["event"] for r in replica]
+               == ["offer_accept", "drain_start", "replan_done"],
+               leg, f"replica audit chain {[r['event'] for r in replica]}")
+        offer_t = [r["t_wall"] for r in read_events(ctl_log)
+                   if r.get("action") == "offer_chip"]
+        _check(bool(offer_t) and offer_t[0] <= replica[0]["t_wall"], leg,
+               "offer_chip not audited before the replica's accept")
+        rp = replica[-1]
+        _check(rp["from_mesh"] == {"data": 1}
+               and rp["to_mesh"] == {"data": 2}, leg,
+               f"replan_done meshes {rp['from_mesh']} -> {rp['to_mesh']}")
+        print(
+            f"serving_soak: actuate OK — chip 1 absorbed (dp1 -> dp2), "
+            f"kept on qps/chip {qpc[0]['after']:.1f} >= floor "
+            f"{qpc[0]['expected_floor']:.1f}, {ok_count[0]} requests with "
+            f"0 failures across the drain, bytes bit-identical, "
+            f"monitor never saw dead"
+        )
+    finally:
+        ctl.shutdown()
+        ctl.events.close()
+
+
+def leg_actuate_decline(run_root: str, args) -> None:
+    """A replica under SLO pressure must DECLINE: no drain, no re-plan,
+    the decline audited with its SLO evidence."""
+    import types
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet_controller as fc
+
+    from distributed_training_pytorch_tpu.telemetry.events import (
+        read_events,
+        resolve_events_path,
+    )
+
+    leg = "actuate_decline"
+    root = os.path.join(run_root, "decline")
+    run_dir = os.path.join(root, "server0")
+    os.makedirs(run_dir, exist_ok=True)
+    port = _free_port()
+    trainer = fc.RunSpec(
+        name="trainer0", run_dir=os.path.join(root, "trainer0"),
+        adopt=True, device_ids=(0, 1), mesh="fsdp2",
+    )
+    os.makedirs(trainer.run_dir, exist_ok=True)
+    # An SLO no CPU can meet: the first window breaches, slo_ok -> False.
+    server = _serve_spec(fc, run_dir, port, args, slo_p99_ms=0.001)
+    ctl, _ = _start_fleet(fc, [trainer, server], root, args)
+    try:
+        row = (np.arange(SEQ_LEN, dtype=np.int32) % LM_VOCAB).tolist()
+        _wait_serving(port, row)
+        for _ in range(10):  # populate the latency window past the SLO
+            _post(port, {"tenant": "load", "inputs": [row]})
+        _check(_get_json(port, "/status")["slo_ok"] is False, leg,
+               "replica not under SLO pressure — decline leg is vacuous")
+
+        status = types.SimpleNamespace(attempt=1, status="training",
+                                       verdict="straggler")
+        ctl._offer_freed_chip(
+            ctl.runs["trainer0"], _freed_chip_action(1), status
+        )
+
+        st = _get_json(port, "/status")
+        _check(st["chips"] == 1 and st["replans"] == 0 and st["drains"] == 0,
+               leg, f"decline actuated anyway: {st['chips']} chips, "
+                    f"{st['replans']} replans")
+        kinds = [a.kind for a in ctl.runs["server0"].actions]
+        _check(kinds == ["offer_chip"], leg,
+               f"controller actions {kinds} (decline must not keep/revert)")
+        declines = [r for r in read_events(resolve_events_path(run_dir))
+                    if r.get("event") == "offer_decline"]
+        _check(len(declines) == 1 and "SLO" in declines[0]["reason"], leg,
+               f"decline not audited with SLO evidence: {declines}")
+        print(
+            f"serving_soak: actuate_decline OK — replica under SLO "
+            f"pressure declined chip 1 ({declines[0]['reason']!r}), "
+            f"nothing drained, nothing re-planned"
+        )
+    finally:
+        ctl.shutdown()
+        ctl.events.close()
+
+
+def leg_actuate_timeout(run_root: str, args) -> None:
+    """A handshake that cannot reach its replica reverts cleanly and
+    re-arms: the freed chip stays offerable. No child process — the
+    port points at nothing, which IS the failure under test."""
+    import types
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet_controller as fc
+
+    leg = "actuate_timeout"
+    root = os.path.join(run_root, "timeout")
+    trainer = fc.RunSpec(
+        name="trainer0", run_dir=os.path.join(root, "trainer0"),
+        adopt=True, device_ids=(0, 1), mesh="fsdp2",
+    )
+    dead_port = _free_port()  # nothing listens here
+    server = fc.RunSpec(
+        name="server0", run_dir=os.path.join(root, "server0"),
+        kind="serve", adopt=True, port=dead_port,
+    )
+    for spec in (trainer, server):
+        os.makedirs(spec.run_dir, exist_ok=True)
+    ctl, _ = _start_fleet(fc, [trainer, server], root, args)
+    try:
+        status = types.SimpleNamespace(attempt=1, status="training",
+                                       verdict="straggler")
+        for _ in range(2):  # re-armed: the SECOND offer must still fire
+            ctl._offer_freed_chip(
+                ctl.runs["trainer0"], _freed_chip_action(1), status
+            )
+        acts = ctl.runs["server0"].actions
+        kinds = [a.kind for a in acts]
+        _check(kinds == ["offer_chip", "revert"] * 2, leg,
+               f"controller actions {kinds}")
+        rev = acts[1]
+        _check(rev.reason == "offer_timeout", leg,
+               f"revert reason {rev.reason}")
+        _check(rev.params["rearmed"] is True, leg, "revert did not re-arm")
+        _check(rev.params["handshake_state"] == "offered", leg,
+               f"handshake died in state {rev.params['handshake_state']}")
+        print(
+            f"serving_soak: actuate_timeout OK — unreachable replica on "
+            f":{dead_port} reverted ({rev.reason}), offer re-armed and "
+            f"fired again"
+        )
+    finally:
+        ctl.shutdown()
+        ctl.events.close()
+
+
+def run_actuate(args) -> int:
+    compat.force_host_devices(8)
+    import tempfile
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="serving_actuate_") as run_root:
+        for leg_fn in (leg_actuate, leg_actuate_decline, leg_actuate_timeout):
+            try:
+                leg_fn(run_root, args)
+            except SoakFailure as e:
+                failures.append(str(e))
+                print(f"serving_soak: FAIL {e}", file=sys.stderr)
+    if failures:
+        print(f"serving_soak: {len(failures)} actuate leg(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("serving_soak: PASS — all actuate legs green")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
 
@@ -813,10 +1220,22 @@ def main() -> int:
                         help="Poisson arrival rate (default 60, 30 with --quick)")
     parser.add_argument("--slo-p99-ms", type=float, default=500.0,
                         help="p99 SLO asserted by the slo leg and exported by every server")
+    parser.add_argument("--actuate", action="store_true",
+                        help="run the actuated-offer legs instead (ISSUE 20)")
     parser.add_argument("--serve-worker", action="store_true",
                         help="child mode: one supervised replica (failover leg)")
     parser.add_argument("--run-dir", default=None)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--mesh-spec", default="tp2",
+                        help="serve-worker mesh spec (actuate legs use dp1)")
+    parser.add_argument("--device-ids", default="",
+                        help="serve-worker: comma-separated device ids to serve on")
+    parser.add_argument("--device-count", type=int, default=2,
+                        help="serve-worker: forced host device count")
+    parser.add_argument("--buckets", default="1,2,4,8",
+                        help="serve-worker: comma-separated batch buckets")
+    parser.add_argument("--window-s", type=float, default=30.0,
+                        help="serve-worker: trailing latency/QPS window")
     parser.add_argument("--neutrality-worker", action="store_true",
                         help="child mode: short deterministic trainer run (neutrality leg)")
     parser.add_argument("--with-serving", action="store_true",
@@ -830,6 +1249,8 @@ def main() -> int:
         return neutrality_worker(args)
     if args.serve_worker:
         return serve_worker(args)
+    if args.actuate:
+        return run_actuate(args)
     return run_soak(args)
 
 

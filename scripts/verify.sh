@@ -148,7 +148,21 @@
 # decision, touch nothing) and exit non-zero: the controller never acts
 # without budget.
 #
-# Stage 18 is the streaming-data soak (ISSUE 19; docs/data.md): a real
+# Stage 18 is the actuated-offer soak (ISSUE 20; docs/serving.md "Drain,
+# re-plan, and degraded mode"): serving_soak.py --actuate drives the full
+# self-healing handshake against real subprocess replicas — a chip freed by
+# a trainer's restart_excluding is offered over /admin/offer, the accepting
+# dp1 replica drains (bounded deadline, typed 503 + Retry-After) and
+# re-plans live onto dp2, and the absorb is A/B-judged on QPS-per-chip with
+# the chip-scaled expected floor and KEPT; RetryClient traffic rides the
+# drain with ZERO failed requests and bit-identical response bytes across
+# the re-plan; the offer_chip -> offer_accept -> drain_start -> replan_done
+# audit chain is asserted in wall-clock order across both flight recorders;
+# a monitor polling throughout must never read the draining replica as
+# dead. A replica under SLO pressure must DECLINE (nothing drained), and a
+# handshake against an unreachable replica must revert cleanly and re-arm.
+#
+# Stage 19 is the streaming-data soak (ISSUE 19; docs/data.md): a real
 # digits run streaming DTPR1 record shards through the StreamingLoader's
 # decode pool, killed (SIGTERM + SIGKILL) at seeded offsets and resumed
 # from latest_valid — the consumed record-id sequence must be
@@ -162,12 +176,12 @@
 # corrupt-record leg must skip-and-count under skip_corrupt; and the clean
 # streaming run must read 'healthy' from run_doctor (never data_bound).
 #
-# Stage 19 is the ROADMAP.md tier-1 command verbatim.
+# Stage 20 is the ROADMAP.md tier-1 command verbatim.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/19: import health (pytest --collect-only) =="
+echo "== stage 1/20: import health (pytest --collect-only) =="
 if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
     -p no:cacheprovider > /tmp/_collect.log 2>&1; then
   echo "COLLECTION FAILED — import breakage (full log: /tmp/_collect.log):"
@@ -176,7 +190,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
 fi
 tail -1 /tmp/_collect.log
 
-echo "== stage 2/19: static audit (generic + jaxlint + HLO + comm) =="
+echo "== stage 2/20: static audit (generic + jaxlint + HLO + comm) =="
 if ! JAX_PLATFORMS=cpu python scripts/static_audit.py; then
   echo "STATIC AUDIT FAILED — fix the finding or waive it inline with a reason"
   echo "(# jaxlint: disable=<rule> -- <why>; catalog: docs/static_analysis.md;"
@@ -202,25 +216,25 @@ if JAX_PLATFORMS=cpu python scripts/static_audit.py --inject-violation comm --sk
 fi
 echo "static_audit self-tests OK: injected lint + donation + comm violations correctly failed"
 
-echo "== stage 3/19: chained-dispatch retrace guard =="
+echo "== stage 3/20: chained-dispatch retrace guard =="
 if ! JAX_PLATFORMS=cpu python scripts/retrace_guard.py; then
   echo "RETRACE GUARD FAILED — the chained executable recompiles per window"
   exit 4
 fi
 
-echo "== stage 4/19: mixed-precision smoke (bf16 digits) =="
+echo "== stage 4/20: mixed-precision smoke (bf16 digits) =="
 if ! JAX_PLATFORMS=cpu python scripts/precision_smoke.py; then
   echo "PRECISION SMOKE FAILED — bf16 training path regressed"
   exit 5
 fi
 
-echo "== stage 5/19: telemetry smoke (event log + goodput + stats) =="
+echo "== stage 5/20: telemetry smoke (event log + goodput + stats) =="
 if ! JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py; then
   echo "TELEMETRY SMOKE FAILED — observability subsystem regressed"
   exit 6
 fi
 
-echo "== stage 6/19: memory-accounting gate (preflight parity + oversize self-test) =="
+echo "== stage 6/20: memory-accounting gate (preflight parity + oversize self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py; then
   echo "MEMORY PROBE FAILED — preflight prediction drifted from compiled.memory_analysis()"
   exit 7
@@ -230,26 +244,26 @@ if ! JAX_PLATFORMS=cpu python scripts/memory_probe.py --inject-oversize; then
   exit 7
 fi
 
-echo "== stage 7/19: sharded-training smoke (FSDP/TP parity + resharding resume) =="
+echo "== stage 7/20: sharded-training smoke (FSDP/TP parity + resharding resume) =="
 if ! JAX_PLATFORMS=cpu python scripts/sharding_smoke.py; then
   echo "SHARDING SMOKE FAILED — FSDP/TP parity, sharded retrace guard, or the resharding restore path regressed"
   exit 8
 fi
 
-echo "== stage 8/19: chaos soak (kill/resume, async checkpointing) =="
+echo "== stage 8/20: chaos soak (kill/resume, async checkpointing) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --quick; then
   echo "CHAOS SOAK FAILED — recovery machinery regressed (reproduce: CHAOS_SEED)"
   exit 9
 fi
 
-echo "== stage 9/19: elastic chaos soak (kill on N devices, resume on M) =="
+echo "== stage 9/20: elastic chaos soak (kill on N devices, resume on M) =="
 if ! JAX_PLATFORMS=cpu python scripts/chaos_soak.py --elastic --quick; then
   echo "ELASTIC CHAOS SOAK FAILED — the N->M mesh re-plan / batch-equivalent"
   echo "restore regressed (reproduce: CHAOS_SEED; docs/fault_tolerance.md)"
   exit 11
 fi
 
-echo "== stage 10/19: perf-regression gate (clean + injected-slowdown self-test) =="
+echo "== stage 10/20: perf-regression gate (clean + injected-slowdown self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick; then
   echo "PERF GATE FAILED — step time regressed past tolerance vs PERF_BASELINE.json"
   echo "(legitimate perf change? re-record: scripts/perf_gate.py --quick --update)"
@@ -261,7 +275,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick --inject-slowdown 3; th
 fi
 echo "perf_gate self-test OK: injected 3x regression correctly failed"
 
-echo "== stage 11/19: data-wait gate (clean + injected-starvation self-test) =="
+echo "== stage 11/20: data-wait gate (clean + injected-starvation self-test) =="
 if ! JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait; then
   echo "DATA-WAIT GATE FAILED — the input pipeline's steady-state data_wait"
   echo "fraction exceeds the PERF_BASELINE.json ceiling (ROADMAP item 5)"
@@ -275,7 +289,7 @@ if JAX_PLATFORMS=cpu python scripts/perf_gate.py --data-wait --inject-data-wait 
 fi
 echo "data-wait gate self-test OK: injected loader sleep correctly failed"
 
-echo "== stage 12/19: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
+echo "== stage 12/20: run-doctor self-test (injected-bottleneck diagnosis + timeline) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   echo "RUN DOCTOR SELF-TEST FAILED — an injected bottleneck was misdiagnosed,"
   echo "the clean twin was not healthy, or the exported timeline broke the"
@@ -283,7 +297,7 @@ if ! JAX_PLATFORMS=cpu python scripts/run_doctor.py --self-test; then
   exit 13
 fi
 
-echo "== stage 13/19: live-monitor self-test (heartbeat liveness + streaming doctor + alerts) =="
+echo "== stage 13/20: live-monitor self-test (heartbeat liveness + streaming doctor + alerts) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_monitor.py --self-test; then
   echo "RUN MONITOR SELF-TEST FAILED — the liveness contract broke: a hang did"
   echo "not read stale_heartbeat, a SIGKILL did not read dead, the healthy twin"
@@ -292,7 +306,7 @@ if ! JAX_PLATFORMS=cpu python scripts/run_monitor.py --self-test; then
   exit 15
 fi
 
-echo "== stage 14/19: run-comparison gate (twin-diff + injected attribution + bench history) =="
+echo "== stage 14/20: run-comparison gate (twin-diff + injected attribution + bench history) =="
 if ! JAX_PLATFORMS=cpu python scripts/run_compare.py --self-test; then
   echo "RUN COMPARE SELF-TEST FAILED — identical twins did not diff clean, or"
   echo "an injected known-cause slowdown (3x conv / loader sleep / commit"
@@ -307,7 +321,7 @@ if ! JAX_PLATFORMS=cpu python scripts/bench_history.py --self-test; then
   exit 14
 fi
 
-echo "== stage 15/19: autotune gate (injected-win ranking + provenance refusal) + pallas parity =="
+echo "== stage 15/20: autotune gate (injected-win ranking + provenance refusal) + pallas parity =="
 if ! JAX_PLATFORMS=cpu python scripts/autotune.py --self-test; then
   echo "AUTOTUNE SELF-TEST FAILED — the injected known-win (3x de-tuned"
   echo "baseline) was not ranked first with per-category attribution, a"
@@ -324,7 +338,7 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/test_pallas.py tests/test_dispatch
 fi
 tail -1 /tmp/_pallas_parity.log
 
-echo "== stage 16/19: fleet-controller soak (closed-loop recovery + zero-budget refusal) =="
+echo "== stage 16/20: fleet-controller soak (closed-loop recovery + zero-budget refusal) =="
 if ! JAX_PLATFORMS=cpu python scripts/fleet_controller.py --soak --quick; then
   echo "FLEET SOAK FAILED — the closed-loop controller did not restore the"
   echo "diseased fleet to healthy (restart / restart_excluding / A/B tune),"
@@ -340,7 +354,7 @@ if JAX_PLATFORMS=cpu python scripts/fleet_controller.py --soak --quick --max-res
 fi
 echo "fleet soak self-test OK: zero-budget controller refused without acting"
 
-echo "== stage 17/19: serving soak (continuous-batching SLO + hot-swap + failover) =="
+echo "== stage 17/20: serving soak (continuous-batching SLO + hot-swap + failover) =="
 if ! JAX_PLATFORMS=cpu python scripts/serving_soak.py --quick; then
   echo "SERVING SOAK FAILED — the p99 SLO was breached, responses were not"
   echo "bit-identical across a checkpoint hot-swap, a SIGKILL'd replica was"
@@ -349,7 +363,18 @@ if ! JAX_PLATFORMS=cpu python scripts/serving_soak.py --quick; then
   exit 18
 fi
 
-echo "== stage 18/19: streaming-data soak (kill/resume determinism + elastic re-split) =="
+echo "== stage 18/20: actuated-offer soak (drain + live re-plan + A/B keep) =="
+if ! JAX_PLATFORMS=cpu python scripts/serving_soak.py --actuate --quick; then
+  echo "ACTUATE SOAK FAILED — the actuated chip offer regressed: a request"
+  echo "failed or hung across the drain window, response bytes changed across"
+  echo "the live re-plan, the offer/accept/drain/replan audit chain broke,"
+  echo "the A/B judge mis-called the absorb, an SLO-pressured replica did not"
+  echo "decline, a dead-replica handshake did not revert-and-re-arm, or the"
+  echo "monitor read a draining replica as dead (docs/serving.md)"
+  exit 20
+fi
+
+echo "== stage 19/20: streaming-data soak (kill/resume determinism + elastic re-split) =="
 if ! JAX_PLATFORMS=cpu python scripts/data_soak.py --quick; then
   echo "DATA SOAK FAILED — the streaming reader's deterministic-resume,"
   echo "elastic re-split, worker-respawn, or corrupt-skip contract regressed"
@@ -357,7 +382,7 @@ if ! JAX_PLATFORMS=cpu python scripts/data_soak.py --quick; then
   exit 19
 fi
 
-echo "== stage 19/19: tier-1 test suite =="
+echo "== stage 20/20: tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -1,0 +1,647 @@
+"""ISSUE 20 tests: graceful drain, live re-plan, and the actuated offer.
+
+Acceptance pillars:
+
+* the server's admission state machine: a drain stops admission with a
+  typed 503 + ``Retry-After``, flushes in-flight micro-batches to
+  completion under the bounded deadline (a batch executing AT the
+  deadline is still answered 200), sheds past-deadline queued rows as
+  typed 503s (never a hang, never a dropped row), and no dispatch
+  threads leak across N drain/resume cycles (the DecodePool-style
+  accounting of satellite 3);
+* ``InferEngine.replan_onto``: bit-identical outputs for identical
+  params across a device-set change, executables rebuilt, and an
+  infeasible target refused with the old plan untouched (the
+  controller's revert path depends on that);
+* the hot-swap watcher is gated behind the drain's state machine — a
+  checkpoint commit landing mid-drain must not flip params (satellite 2
+  regression);
+* ``Retry-After`` on 429/503 derived from queue depth, recorded on the
+  ``admission_reject`` event with its ``reason`` (satellite 1);
+* :class:`serving.client.RetryClient`: honors ``Retry-After`` over its
+  own backoff, retries only 429/503/transport, bounded attempts with a
+  typed give-up — on an injected transport, no sockets or sleeps;
+* :class:`telemetry.controller.OfferHandshake`: the chip-count-scaled
+  A/B judge (absorbing a chip halves per-chip QPS under fixed open-loop
+  load — the naive compare would always revert), SLO-primacy, decline
+  and timeout terminality;
+* the monitor reads a draining replica as ``draining`` — never ``dead``
+  (the tentpole's monitor clause).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_pytorch_tpu.parallel import mesh_config_from_spec
+from distributed_training_pytorch_tpu.serving import MicroBatcher
+from distributed_training_pytorch_tpu.serving.client import (
+    RetriesExhausted,
+    RetryClient,
+)
+from distributed_training_pytorch_tpu.serving.engine import InferEngine
+from distributed_training_pytorch_tpu.serving.server import InferenceServer
+from distributed_training_pytorch_tpu.telemetry.controller import OfferHandshake
+from distributed_training_pytorch_tpu.telemetry.events import (
+    read_events,
+    resolve_events_path,
+)
+from distributed_training_pytorch_tpu.telemetry.monitor import (
+    AlertConfig,
+    RunMonitor,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _linear_params(seed=0, d=4):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((d, d)).astype(np.float32)}
+
+
+def _linear_apply(params, x):
+    return x @ params["w"]
+
+
+class _Dev:
+    def __init__(self, i):
+        self.id = i
+
+
+class _StubMesh:
+    shape = {"data": 1}
+    devices = np.array([_Dev(0)], dtype=object)
+
+
+class StubEngine:
+    """The engine surface the server's drain path reads, with an optional
+    per-call gate so a test can hold a micro-batch in flight across a
+    drain deadline (impossible with a jitted engine — blocking inside the
+    traced body would block per-trace, not per-call)."""
+
+    buckets = (1, 2, 4)
+    params_version = "stub@e0"
+    swap_count = 0
+
+    def __init__(self, gate=None):
+        self.gate = gate
+        self.mesh = _StubMesh()
+        self.replan_count = 0
+        self.predicted = 0
+
+    def predict(self, inputs):
+        if self.gate is not None:
+            assert self.gate.wait(10.0), "test gate never released"
+        self.predicted += int(np.asarray(inputs).shape[0])
+        return np.asarray(inputs) * 2.0, self.params_version
+
+    def warmup(self, row):
+        return 0.0
+
+
+def _wait(predicate, timeout=5.0, tick=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Retry-After derivation + admission_reject vocabulary (satellite 1).
+
+
+def test_retry_after_on_429_and_event_reason(tmp_path):
+    server = InferenceServer(
+        StubEngine(),
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_queue_depth=0),
+        run_dir=str(tmp_path),
+        process_index=0,
+    )
+    code, body, headers = server.handle_predict(
+        "t0", np.ones((1, 4), np.float32)
+    )
+    assert code == 429
+    # The 429 body is the pre-existing exact contract (soak-pinned): the
+    # Retry-After signal is header-only, never a body change.
+    assert json.loads(body) == {
+        "error": "overload", "tenant": "t0", "depth": 0, "bound": 0,
+    }
+    assert int(headers["Retry-After"]) >= 1
+    server.events.close()
+    recs = [
+        r for r in read_events(resolve_events_path(str(tmp_path)))
+        if r.get("event") == "admission_reject"
+    ]
+    assert recs and recs[0]["reason"] == "overload"
+    assert recs[0]["retry_after_s"] == int(headers["Retry-After"])
+
+
+def test_retry_after_floored_by_drain_deadline():
+    server = InferenceServer(StubEngine(), process_index=0)
+    server.state = "draining"
+    server._drain_deadline = server._clock() + 7.0
+    assert server.retry_after_s() >= 7
+
+
+# ---------------------------------------------------------------------------
+# The drain state machine (tentpole a).
+
+
+def test_drain_sheds_queued_rows_as_typed_503(tmp_path):
+    """No dispatch loop running: everything queued at the deadline is shed
+    — answered (typed 503 + Retry-After), never hung, never dropped."""
+    server = InferenceServer(
+        StubEngine(),
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_delay_s=0.005),
+        run_dir=str(tmp_path),
+        process_index=0,
+    )
+    results = {}
+
+    def call():
+        results["r"] = server.handle_predict(
+            "t0", np.ones((2, 4), np.float32)
+        )
+
+    t = threading.Thread(target=call)
+    t.start()
+    assert _wait(lambda: server.batcher.pending() == 2)
+    summary = server.drain(deadline_s=0.05)
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "shed request hung instead of answering"
+    assert summary["shed"] == 2
+    code, body, headers = results["r"]
+    payload = json.loads(body)
+    assert code == 503 and payload["error"] == "draining"
+    assert "drain deadline exceeded" in payload["detail"]
+    assert int(headers["Retry-After"]) >= 1
+    # Drained => quiesced; a second drain is a caller bug, typed.
+    assert server.state == "replanning"
+    with pytest.raises(RuntimeError, match="already replanning"):
+        server.drain()
+    # Admission while quiesced: immediate typed 503, nothing queued.
+    code, body, headers = server.handle_predict(
+        "t0", np.ones((1, 4), np.float32)
+    )
+    assert code == 503 and json.loads(body)["state"] == "replanning"
+    assert server.batcher.pending() == 0
+    server.resume()
+    assert server.state == "serving"
+    server.events.close()
+    recs = list(read_events(resolve_events_path(str(tmp_path))))
+    drains = [r for r in recs if r.get("event") == "drain_start"]
+    assert len(drains) == 1 and drains[0]["pending"] == 2
+    rejects = [r for r in recs if r.get("event") == "admission_reject"]
+    assert rejects and rejects[0]["reason"] == "replanning"
+
+
+def test_batch_in_flight_at_deadline_completes_200():
+    """Satellite 3 boundary: a micro-batch already EXECUTING when the
+    drain deadline passes is never shed — its rows answer 200."""
+    gate = threading.Event()
+    server = InferenceServer(
+        StubEngine(gate),
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_delay_s=0.001),
+        process_index=0,
+    ).start()
+    try:
+        results = {}
+
+        def call():
+            results["r"] = server.handle_predict(
+                "t0", np.ones((1, 4), np.float32)
+            )
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert _wait(lambda: server._inflight == 1)
+        drain_summary = {}
+
+        def run_drain():
+            drain_summary.update(server.drain(deadline_s=0.05))
+
+        dt = threading.Thread(target=run_drain)
+        dt.start()
+        # Let the deadline pass with the batch still executing, then
+        # release it: drain must wait it out, not shed it.
+        time.sleep(0.1)
+        gate.set()
+        dt.join(timeout=5.0)
+        t.join(timeout=5.0)
+        assert not dt.is_alive() and not t.is_alive()
+        code, body, _ = results["r"]
+        assert code == 200, body
+        assert json.loads(body)["outputs"] == [[2.0, 2.0, 2.0, 2.0]]
+        assert drain_summary["shed"] == 0
+        server.resume()
+    finally:
+        gate.set()
+        server.close()
+
+
+def test_no_thread_leak_across_drain_resume_cycles():
+    """Satellite 3: DecodePool-style accounting — N drain/resume cycles
+    reuse the same dispatch machinery; thread count stays flat and the
+    in-flight counter returns to zero every cycle."""
+    server = InferenceServer(
+        StubEngine(),
+        batcher=MicroBatcher(buckets=(1, 2, 4), max_delay_s=0.001),
+        process_index=0,
+    ).start()
+    try:
+        baseline_threads = threading.active_count()
+        n_started = len(server._threads)
+        for cycle in range(5):
+            code, body, _ = server.handle_predict(
+                "t0", np.ones((1, 4), np.float32)
+            )
+            assert code == 200, f"cycle {cycle}: {body}"
+            server.drain(deadline_s=0.05)
+            assert server._inflight == 0
+            server.resume()
+        code, _, _ = server.handle_predict("t0", np.ones((1, 4), np.float32))
+        assert code == 200
+        assert server.drain_count == 5
+        assert len(server._threads) == n_started
+        assert threading.active_count() <= baseline_threads
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine re-plan: bit identity + pre-mutation refusal.
+
+
+def test_engine_replan_bit_identity_and_refusal():
+    devs = jax.devices()
+    eng = InferEngine(
+        _linear_apply,
+        mesh_config_from_spec("dp1").build(devs[:1]),
+        buckets=(2, 4, 8),
+    )
+    eng.swap_params(_linear_params(seed=3), version="best@e1")
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    before, v0 = eng.predict(x)
+
+    eng.replan_onto(mesh_config_from_spec("dp2").build(devs[:2]))
+    assert eng.replan_count == 1
+    assert eng._executables == {}  # old-mesh closures dropped
+    after, v1 = eng.predict(x)
+    # Identical bytes for identical params: dp growth replicates params
+    # and only re-splits the batch axis — per-row math is unchanged.
+    assert v1 == v0 == "best@e1"
+    np.testing.assert_array_equal(before, after)
+
+    # Infeasible target (2 % 3 != 0): refused BEFORE any state mutation —
+    # the engine keeps serving the dp2 plan it had.
+    with pytest.raises(ValueError, match="batch-shard extent"):
+        eng.replan_onto(mesh_config_from_spec("dp3").build(devs[:3]))
+    assert eng.replan_count == 1
+    assert dict(eng.mesh.shape) == {"data": 2}
+    again, _ = eng.predict(x)
+    np.testing.assert_array_equal(before, again)
+
+
+def test_server_replan_refusal_keeps_serving():
+    """handle_replan on an infeasible target: typed 400, admission never
+    stopped, no drain consumed (the controller's revert contract)."""
+    devs = jax.devices()
+    eng = InferEngine(
+        _linear_apply,
+        mesh_config_from_spec("dp1").build(devs[:1]),
+        buckets=(2, 4, 8),
+    )
+    eng.swap_params(_linear_params(seed=3), version="best@e1")
+    server = InferenceServer(
+        eng,
+        batcher=MicroBatcher(buckets=(2, 4, 8), max_delay_s=0.002),
+        process_index=0,
+    ).start()
+    try:
+        code, body, _ = server.handle_replan({"device_ids": [0, 1, 2]})
+        assert code == 400
+        payload = json.loads(body)
+        assert payload["error"] == "replan_failed"
+        assert payload["state"] == "serving"
+        assert server.drain_count == 0 and eng.replan_count == 0
+        code, _, _ = server.handle_predict(
+            "t0", np.ones((2, 4), np.float32)
+        )
+        assert code == 200
+        # Unknown device ids are refused the same way.
+        code, body, _ = server.handle_replan({"device_ids": [0, 99]})
+        assert code == 400 and "unknown device" in json.loads(body)["detail"]
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: the hot-swap watcher is gated behind the drain.
+
+
+def test_swap_watcher_gated_during_drain(tmp_path):
+    """A checkpoint commit landing mid-drain must NOT flip params; the
+    watcher re-arms after resume and then swaps (regression for the
+    swap-vs-replan race)."""
+
+    class StubState:
+        def __init__(self, params):
+            self.params = params
+
+    ckpt_root = tmp_path / "ckpts"
+
+    class StubManager:
+        MANIFEST = "manifest.json"
+
+        def __init__(self):
+            self.store = {}
+
+        def commit(self, name, params, epoch):
+            d = ckpt_root / name
+            d.mkdir(parents=True, exist_ok=True)
+            self.store[name] = (params, epoch)
+            tmp = d / ".manifest.tmp"
+            tmp.write_text(json.dumps({"epoch": epoch}))
+            os.replace(tmp, d / self.MANIFEST)
+
+        def exists(self, name):
+            return name in self.store
+
+        def path(self, name):
+            return str(ckpt_root / name)
+
+        def latest_valid_name(self):
+            return None
+
+        def restore(self, name, target_state, params_only=False):
+            params, epoch = self.store[name]
+            return StubState(params), epoch
+
+    import distributed_training_pytorch_tpu.checkpoint.manager as mgr_mod
+
+    manager = StubManager()
+    manager.commit("best", _linear_params(seed=11), epoch=1)
+    eng = InferEngine(
+        _linear_apply,
+        mesh_config_from_spec("dp1").build(jax.devices()[:1]),
+        buckets=(1, 2),
+    )
+    real_manifest = mgr_mod.MANIFEST_NAME
+    try:
+        mgr_mod.MANIFEST_NAME = StubManager.MANIFEST
+        server = InferenceServer(
+            eng,
+            batcher=MicroBatcher(buckets=(1, 2), max_delay_s=0.002),
+            manager=manager,
+            target_state=object(),
+            serve_name="best",
+            swap_poll_s=0.02,
+            process_index=0,
+        ).start()
+        try:
+            assert _wait(lambda: eng.params_version == "best@e1")
+            swaps_before = eng.swap_count
+            server.drain(deadline_s=0.05)
+            # A new epoch lands while quiesced: the watcher must sit out.
+            manager.commit("best", _linear_params(seed=12), epoch=2)
+            time.sleep(6 * server.swap_poll_s)
+            assert eng.swap_count == swaps_before
+            assert eng.params_version == "best@e1"
+            server.resume()
+            # First poll after resume re-derives the candidate from disk:
+            # nothing was missed, the gated commit lands now.
+            assert _wait(lambda: eng.params_version == "best@e2")
+        finally:
+            server.close()
+    finally:
+        mgr_mod.MANIFEST_NAME = real_manifest
+
+
+# ---------------------------------------------------------------------------
+# The replica's offer decision.
+
+
+def test_handle_offer_decline_under_slo_pressure(tmp_path):
+    server = InferenceServer(
+        StubEngine(), run_dir=str(tmp_path), slo_p99_ms=10.0,
+        process_index=0,
+    )
+    # Healthy (no traffic in window): accept.
+    code, body, _ = server.handle_offer({"chip": 3})
+    assert code == 200 and json.loads(body)["decision"] == "accept"
+    # Breaching: the replica must not take a drain+recompile window on
+    # top of an SLO breach — decline, with the evidence in the record.
+    now = server._clock()
+    for _ in range(20):
+        server.window.add(now, 500.0)
+    code, body, _ = server.handle_offer({"chip": 3})
+    payload = json.loads(body)
+    assert payload["decision"] == "decline"
+    assert "SLO pressure" in payload["reason"]
+    # Mid-drain: decline too.
+    server.state = "draining"
+    code, body, _ = server.handle_offer({"chip": 3})
+    assert json.loads(body)["reason"] == "replica is draining"
+    server.state = "serving"
+    # No chip: typed 400.
+    code, _, _ = server.handle_offer({})
+    assert code == 400
+    server.events.close()
+    kinds = [
+        r["event"] for r in read_events(resolve_events_path(str(tmp_path)))
+        if r.get("event", "").startswith("offer_")
+    ]
+    assert kinds == ["offer_accept", "offer_decline", "offer_decline"]
+
+
+# ---------------------------------------------------------------------------
+# OfferHandshake: the chip-scaled A/B judge.
+
+
+def test_offer_handshake_keep_requires_chip_scaled_floor():
+    hs = OfferHandshake(
+        1,
+        before={"qps_per_chip": 100.0, "p99_ms": 5.0, "slo_ok": True,
+                "chips": 1},
+        now=0.0, timeout_s=60.0, settle_s=2.0,
+    )
+    hs.note_decision("accept", "healthy")
+    hs.note_actuated({"shed": 0}, now=1.0)
+    assert not hs.ready_to_judge(2.0) and hs.ready_to_judge(3.0)
+    # Fixed-rate open-loop load over 1 -> 2 chips: per-chip QPS halves BY
+    # CONSTRUCTION. 50/chip is the expected value, not a regression — a
+    # naive before>=after compare would revert every absorb ever made.
+    verdict, evidence = hs.judge(
+        {"qps_per_chip": 48.0, "p99_ms": 4.0, "slo_ok": True, "chips": 2}
+    )
+    assert verdict == "keep" and hs.state == "kept"
+    row = next(e for e in evidence if e["metric"] == "qps_per_chip")
+    assert row["expected_floor"] == pytest.approx(45.0)  # 100*(1/2)*0.9
+    assert {"p99_ms", "slo_ok"} <= {e["metric"] for e in evidence}
+
+
+def test_offer_handshake_reverts_on_slo_or_throughput():
+    def fresh():
+        hs = OfferHandshake(
+            1, before={"qps_per_chip": 100.0, "chips": 1, "slo_ok": True},
+            now=0.0, settle_s=0.0,
+        )
+        hs.note_decision("accept")
+        hs.note_actuated({}, now=0.0)
+        return hs
+
+    # SLO is primary: great throughput cannot save a breached absorb.
+    hs = fresh()
+    verdict, _ = hs.judge(
+        {"qps_per_chip": 60.0, "chips": 2, "slo_ok": False}
+    )
+    assert verdict == "revert" and "SLO" in hs.reason
+    # Below the chip-scaled floor (45.0): revert.
+    hs = fresh()
+    verdict, _ = hs.judge(
+        {"qps_per_chip": 30.0, "chips": 2, "slo_ok": True}
+    )
+    assert verdict == "revert" and hs.state == "reverted"
+
+
+def test_offer_handshake_decline_and_expiry_are_terminal():
+    hs = OfferHandshake(2, before={}, now=0.0, timeout_s=10.0)
+    hs.note_decision("decline", "under SLO pressure")
+    assert hs.done and hs.state == "declined"
+    assert not hs.expired(100.0)  # terminal states never expire
+    with pytest.raises(RuntimeError):
+        hs.note_actuated({}, now=1.0)
+
+    hs2 = OfferHandshake(2, before={}, now=0.0, timeout_s=10.0)
+    assert not hs2.expired(9.9)
+    assert hs2.expired(10.0) and hs2.state == "expired"
+    with pytest.raises(RuntimeError):
+        hs2.note_decision("accept")
+
+
+# ---------------------------------------------------------------------------
+# RetryClient: policy on an injected transport (no sockets, no sleeps).
+
+
+def _fake_transport(script):
+    """Each entry: (status, body_dict, headers) or an Exception to raise."""
+    calls = []
+
+    def transport(url, body, timeout):
+        step = script[min(len(calls), len(script) - 1)]
+        calls.append(json.loads(body.decode()))
+        if isinstance(step, Exception):
+            raise step
+        status, payload, headers = step
+        return status, json.dumps(payload).encode(), headers
+
+    return transport, calls
+
+
+def test_retry_client_honors_retry_after_then_succeeds():
+    transport, calls = _fake_transport([
+        (503, {"error": "draining"}, {"Retry-After": "3"}),
+        (429, {"error": "overload"}, {"retry-after": "2"}),  # any case
+        (200, {"outputs": [[1.0]]}, {}),
+    ])
+    sleeps = []
+    cli = RetryClient(
+        max_attempts=5, base_delay_s=0.01, jitter=0.0,
+        transport=transport, sleep=sleeps.append,
+    )
+    status, body = cli.post_json("http://x/predict", {"inputs": [[1]]})
+    assert status == 200 and body == {"outputs": [[1.0]]}
+    assert len(calls) == 3
+    # The server's Retry-After dominates the (tiny) exponential backoff.
+    assert sleeps == [3.0, 2.0]
+    assert cli.retries == 2 and cli.gave_up == 0
+
+
+def test_retry_client_bounded_attempts_typed_give_up():
+    transport, calls = _fake_transport([
+        (503, {"error": "draining"}, {"Retry-After": "1"}),
+    ])
+    cli = RetryClient(
+        max_attempts=3, base_delay_s=0.001, jitter=0.0,
+        transport=transport, sleep=lambda s: None,
+    )
+    with pytest.raises(RetriesExhausted) as exc:
+        cli.post_json("http://x/predict", {"inputs": [[1]]})
+    assert len(calls) == 3 and cli.gave_up == 1
+    assert [a["status"] for a in exc.value.attempts] == [503, 503, 503]
+    assert all(a["retry_after_s"] == 1.0 for a in exc.value.attempts)
+
+
+def test_retry_client_does_not_retry_terminal_statuses():
+    for status in (400, 500):
+        transport, calls = _fake_transport([(status, {"error": "x"}, {})])
+        cli = RetryClient(transport=transport, sleep=lambda s: None)
+        got, body = cli.post_json("http://x/predict", {})
+        assert got == status and len(calls) == 1 and cli.retries == 0
+
+
+def test_retry_client_retries_connection_errors():
+    transport, calls = _fake_transport([
+        urllib.error.URLError("connection refused"),
+        (200, {"ok": True}, {}),
+    ])
+    cli = RetryClient(
+        max_attempts=4, base_delay_s=0.001, jitter=0.0,
+        transport=transport, sleep=lambda s: None,
+    )
+    status, body = cli.post_json("http://x/predict", {})
+    assert status == 200 and body == {"ok": True} and len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# Monitor: a draining replica is draining — never dead.
+
+
+def _serve_log(run_dir, recs):
+    os.makedirs(os.path.dirname(resolve_events_path(run_dir)), exist_ok=True)
+    now = time.time()
+    out = [{"event": "serve_start", "t_wall": now - 3.0, "attempt": 1,
+            "port": 1234}]
+    for r in recs:
+        out.append({"t_wall": now, "attempt": 1, **r})
+    with open(resolve_events_path(run_dir), "w") as f:
+        for r in out:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_monitor_reads_drain_as_draining_never_dead(tmp_path):
+    run = str(tmp_path / "srv")
+    _serve_log(run, [
+        {"event": "request_batch", "qps": 10.0, "p99_ms": 2.0,
+         "slo_ok": True, "state": "serving", "qps_per_chip": 10.0,
+         "mesh_chips": 1},
+        {"event": "drain_start", "deadline_s": 10.0, "pending": 4},
+        {"event": "request_batch", "qps": 0.0, "p99_ms": None,
+         "slo_ok": True, "state": "draining", "shed_total": 2},
+    ])
+    st = RunMonitor(run, AlertConfig(stale_after_s=60.0)).poll()
+    assert st.kind == "serve"
+    assert st.status == "draining"  # NOT dead, NOT stale
+    assert st.exit_code != 2
+    assert st.serve["state"] == "draining" and st.serve["shed_total"] == 2
+
+    # replan_done flips it back, and carries the grown chip count.
+    run2 = str(tmp_path / "srv2")
+    _serve_log(run2, [
+        {"event": "drain_start", "deadline_s": 10.0, "pending": 0},
+        {"event": "replan_done", "from_mesh": {"data": 1},
+         "to_mesh": {"data": 2}, "device_ids": [0, 1], "shed": 0},
+        {"event": "request_batch", "qps": 10.0, "p99_ms": 2.0,
+         "slo_ok": True, "state": "serving", "mesh_chips": 2},
+    ])
+    st2 = RunMonitor(run2, AlertConfig(stale_after_s=60.0)).poll()
+    assert st2.status == "serving" and st2.verdict == "healthy"
+    assert st2.serve["mesh_chips"] == 2
